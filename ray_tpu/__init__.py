@@ -1,0 +1,36 @@
+"""ray_tpu — a TPU-native distributed task/actor framework.
+
+A ground-up rebuild of the capabilities of the reference
+(``pschafhalter/ray``, a fork of ``ray-project/ray``): dynamic task graph +
+actor runtime, two-level scheduling, placement groups, shared-memory object
+store with pull-based transfer and spill, lineage fault recovery, autoscaler,
+and observability — with the scheduling data plane evaluated as dense TPU
+computations (JAX/XLA/Pallas) per BASELINE.json's north star.
+
+Public API mirrors the reference's (``ray.init/remote/get/put/wait/...``,
+SURVEY.md §1 layer 9).
+"""
+
+__version__ = "0.1.0"
+
+from .common import (Config, NodeResources, ResourceRequest, get_config)
+
+# The runtime API (init/remote/get/put/...) is imported lazily to keep
+# `import ray_tpu` light for scheduler-only users (e.g. the bench harness).
+_API_NAMES = ("init", "shutdown", "is_initialized", "remote", "get", "put",
+              "wait", "cancel", "kill", "method", "get_runtime_context",
+              "available_resources", "cluster_resources", "nodes")
+
+
+def __getattr__(name):
+    if name in _API_NAMES:
+        from . import api
+        return getattr(api, name)
+    if name == "util":
+        from . import util
+        return util
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+__all__ = ["Config", "get_config", "NodeResources", "ResourceRequest",
+           *_API_NAMES]

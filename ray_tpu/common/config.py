@@ -1,0 +1,165 @@
+"""Single-table configuration registry with environment overrides.
+
+Reference parity: upstream Ray's C++ ``RayConfig`` is one macro table,
+``src/ray/common/ray_config_def.h`` — ``RAY_CONFIG(type, name, default)`` —
+where every entry is overridable via an ``RAY_<name>`` environment variable and
+via the ``_system_config`` JSON passed at init.  [Cited per SURVEY.md §5.6;
+reference mount empty, line numbers unavailable.]
+
+We reproduce the same three-layer precedence with a dataclass-free registry:
+
+    default  <  RT_<NAME> environment variable  <  system_config dict
+
+``Config`` is process-global (like the reference) but ``instance()`` can be
+re-initialised in tests via ``Config.reset(system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable
+
+_ENV_PREFIX = "RT_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+# ---------------------------------------------------------------------------
+# The table.  (type, default, doc)
+# Names follow the reference's knobs where a counterpart exists
+# (scheduler_spread_threshold etc. — SURVEY §5.6 lists the north-star-relevant
+# ones); TPU-specific knobs are new.
+# ---------------------------------------------------------------------------
+_CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
+    # -- scheduling (north star) -------------------------------------------
+    "scheduler_spread_threshold": (
+        float, 0.5,
+        "Hybrid policy: nodes with critical-resource utilization below this "
+        "score like 0 (=> pack by traversal order); above it, rank by score "
+        "(=> spread). Mirrors reference RAY_scheduler_spread_threshold."),
+    "scheduler_top_k_fraction": (
+        float, 0.0,
+        "Fraction of available nodes to sample among the best-k. 0 disables "
+        "sampling (k=1), which is the bit-for-bit parity configuration."),
+    "scheduler_top_k_absolute": (
+        int, 1,
+        "Floor for the top-k node count when top_k_fraction > 0."),
+    "scheduler_report_period_ms": (
+        int, 100,
+        "Resource-view sync period (reference: "
+        "raylet_report_resources_period_milliseconds)."),
+    "scheduler_max_nodes": (
+        int, 8192,
+        "Device key packing supports at most 2**13 nodes (traversal index "
+        "bit width in the packed lexicographic key)."),
+    "scheduler_device_backend": (
+        bool, True,
+        "Evaluate batched placement on the TPU kernel; False forces the CPU "
+        "oracle everywhere (debugging / parity bisection)."),
+    # -- object store -------------------------------------------------------
+    "object_store_memory_mb": (
+        int, 512,
+        "Per-node object store arena size."),
+    "object_spilling_threshold": (
+        float, 0.8,
+        "Fraction of store capacity above which primary copies spill."),
+    "object_spilling_dir": (
+        str, "",
+        "Directory for spilled objects ('' => <session_dir>/spill)."),
+    "pull_manager_max_inflight_mb": (
+        int, 256,
+        "Receiver-driven pull quota (reference PullManager active-pull "
+        "memory cap)."),
+    "max_direct_call_object_size": (
+        int, 100 * 1024,
+        "Results at or below this many bytes return in-band to the owner's "
+        "memory store; larger go to the object store (reference: 100KB)."),
+    # -- runtime ------------------------------------------------------------
+    "num_workers_soft_limit": (
+        int, 0,
+        "Worker pool size; 0 => os.cpu_count()."),
+    "worker_lease_timeout_ms": (int, 10_000, "Lease RPC timeout."),
+    "actor_max_restarts_default": (int, 0, "Default max_restarts for actors."),
+    "task_max_retries_default": (
+        int, 3,
+        "Default max_retries for tasks (reference default: 3)."),
+    "health_check_period_ms": (int, 1000, "GCS -> raylet ping period."),
+    "health_check_failure_threshold": (
+        int, 5, "Missed pings before a node is declared dead."),
+    "lineage_pinning_memory_mb": (
+        int, 256,
+        "Budget for pinned task specs kept for lineage reconstruction."),
+    # -- device -------------------------------------------------------------
+    "tpu_score_scale_bits": (
+        int, 12,
+        "Fixed-point score scale (SCALE = 2**bits). Part of the scheduling "
+        "contract: CPU oracle and TPU kernel share it bit-for-bit."),
+    "tpu_group_capacity": (
+        int, 128,
+        "Padded number of distinct scheduling classes per device batch."),
+    # -- observability ------------------------------------------------------
+    "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
+    "event_log_enabled": (bool, True, "Emit timeline events."),
+    "log_dir": (str, "", "'' => <session_dir>/logs."),
+}
+
+
+class Config:
+    """Resolved configuration. Access values as attributes."""
+
+    _instance: "Config | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self, system_config: dict[str, Any] | None = None):
+        overrides = dict(system_config or {})
+        for name, (typ, default, _doc) in _CONFIG_DEFS.items():
+            value = default
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is not None:
+                value = _PARSERS[typ](env)
+            if name in overrides:
+                raw = overrides.pop(name)
+                value = _PARSERS[typ](raw) if isinstance(raw, str) else typ(raw)
+            setattr(self, name, value)
+        if overrides:
+            raise ValueError(f"unknown config keys: {sorted(overrides)}")
+
+    # -- global accessors ---------------------------------------------------
+    @classmethod
+    def instance(cls) -> "Config":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls, system_config: dict[str, Any] | None = None) -> "Config":
+        with cls._lock:
+            cls._instance = cls(system_config)
+            return cls._instance
+
+    # -- introspection ------------------------------------------------------
+    @classmethod
+    def defs(cls) -> dict[str, tuple[type, Any, str]]:
+        return dict(_CONFIG_DEFS)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {k: getattr(self, k) for k in _CONFIG_DEFS}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+def get_config() -> Config:
+    return Config.instance()

@@ -1,0 +1,177 @@
+"""Cluster-wide resource view: NodeID <-> dense-row mapping + state arrays.
+
+Reference parity: ``ClusterResourceManager`` keeps an
+``absl::flat_hash_map<scheduling::NodeID, Node>`` of ``NodeResources`` and is
+the state every ``ISchedulingPolicy`` reads
+(``src/ray/raylet/scheduling/cluster_resource_manager.h``); a
+``LocalResourceManager`` tracks the owning node's instances
+(``local_resource_manager.h``).  [SURVEY.md §1 layer 5 / §2.1; mount empty.]
+
+TPU-first: the hash-map becomes *dense arrays in traversal order* — the form
+both the numpy oracle and the HBM-resident device state consume.  Node
+addition assigns the next free row; node death frees the row (mask=False) for
+reuse so traversal indices stay < MAX_NODES.  Row order IS the contract's
+deterministic tie-break order, so row assignment is part of observable
+scheduling behavior: rows are assigned in registration order, matching the
+reference's local-node-first traversal when the local node registers first.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..common.ids import NodeID
+from ..common.resources import NodeResources, ResourceIndex, ResourceRequest
+from .contract import MAX_NODES
+from .oracle import ClusterState
+
+
+class ClusterResourceManager:
+    """Owns the dense cluster state + id mapping. Thread-safe."""
+
+    def __init__(self, num_resource_slots: int = 16,
+                 capacity: int = 64):
+        self._lock = threading.RLock()
+        self.resource_index = ResourceIndex()
+        self._r_slots = max(num_resource_slots,
+                            self.resource_index.num_resources)
+        self._capacity = min(capacity, MAX_NODES)
+        self.totals = np.zeros((self._capacity, self._r_slots), dtype=np.int32)
+        self.avail = np.zeros_like(self.totals)
+        self.node_mask = np.zeros(self._capacity, dtype=bool)
+        self._row_of: dict[NodeID, int] = {}
+        self._id_of: dict[int, NodeID] = {}
+        self._labels: dict[int, dict[str, str]] = {}
+        self.version = 0          # bumped on every mutation (device re-sync)
+
+    # -- registration -------------------------------------------------------
+    def add_node(self, node_id: NodeID, resources: NodeResources) -> int:
+        with self._lock:
+            if node_id in self._row_of:
+                raise ValueError(f"node {node_id} already registered")
+            row = self._alloc_row()
+            for name, cu in resources.total_cu.items():
+                col = self._col(name)
+                self.totals[row, col] = cu
+            for name, cu in resources.available_cu.items():
+                self.avail[row, self._col(name)] = cu
+            self.node_mask[row] = True
+            self._row_of[node_id] = row
+            self._id_of[row] = node_id
+            self._labels[row] = dict(resources.labels)
+            self.version += 1
+            return row
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            row = self._row_of.pop(node_id, None)
+            if row is None:
+                return
+            self._id_of.pop(row)
+            self._labels.pop(row, None)
+            self.totals[row] = 0
+            self.avail[row] = 0
+            self.node_mask[row] = False
+            self.version += 1
+
+    def _alloc_row(self) -> int:
+        free = np.flatnonzero(~self.node_mask)
+        # prefer rows never used / lowest index: deterministic traversal order
+        if free.size == 0:
+            if self._capacity * 2 > MAX_NODES:
+                raise RuntimeError(f"cluster exceeds MAX_NODES={MAX_NODES}")
+            self._grow()
+            free = np.flatnonzero(~self.node_mask)
+        return int(free[0])
+
+    def _grow(self):
+        cap = self._capacity * 2
+        for name in ("totals", "avail"):
+            arr = getattr(self, name)
+            new = np.zeros((cap, self._r_slots), dtype=np.int32)
+            new[:self._capacity] = arr
+            setattr(self, name, new)
+        mask = np.zeros(cap, dtype=bool)
+        mask[:self._capacity] = self.node_mask
+        self.node_mask = mask
+        self._capacity = cap
+
+    def _col(self, name: str) -> int:
+        col = self.resource_index.get_or_add(name)
+        if col >= self._r_slots:
+            new = np.zeros((self._capacity, self._r_slots * 2), dtype=np.int32)
+            new[:, :self._r_slots] = self.totals
+            self.totals = new
+            new_a = np.zeros_like(new)
+            new_a[:, :self._r_slots] = self.avail
+            self.avail = new_a
+            self._r_slots *= 2
+        return col
+
+    # -- sync from heartbeats (ray_syncer analogue, SURVEY §2.1) ------------
+    def update_node_available(self, node_id: NodeID,
+                              available_cu: dict[str, int]) -> None:
+        with self._lock:
+            row = self._row_of.get(node_id)
+            if row is None:
+                return
+            for name, cu in available_cu.items():
+                self.avail[row, self._col(name)] = cu
+            self.version += 1
+
+    # -- allocation (used by the dispatch path) -----------------------------
+    def subtract(self, row: int, req: ResourceRequest) -> bool:
+        with self._lock:
+            vec = req.dense(self.resource_index, self._r_slots)
+            if (self.avail[row] < vec).any():
+                return False
+            self.avail[row] -= vec
+            self.version += 1
+            return True
+
+    def add_back(self, row: int, req: ResourceRequest) -> None:
+        with self._lock:
+            vec = req.dense(self.resource_index, self._r_slots)
+            self.avail[row] = np.minimum(self.totals[row],
+                                         self.avail[row] + vec)
+            self.version += 1
+
+    # -- views --------------------------------------------------------------
+    def snapshot(self) -> ClusterState:
+        """Copy-on-read snapshot for a scheduling round (pure-function
+        discipline: policies never see live mutable state — SURVEY §4
+        'every scheduling decision is testable without real distribution')."""
+        with self._lock:
+            return ClusterState(self.totals.copy(), self.avail.copy(),
+                                self.node_mask.copy())
+
+    def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        with self._lock:
+            return self.totals, self.avail, self.node_mask
+
+    def row_of(self, node_id: NodeID) -> int | None:
+        return self._row_of.get(node_id)
+
+    def id_of(self, row: int) -> NodeID | None:
+        return self._id_of.get(row)
+
+    def labels_of(self, row: int) -> dict[str, str]:
+        return dict(self._labels.get(row, {}))
+
+    def num_nodes(self) -> int:
+        return len(self._row_of)
+
+    def label_mask(self, label_selector: dict[str, str]) -> np.ndarray:
+        """(capacity,) bool mask of nodes matching all label k=v pairs."""
+        with self._lock:
+            mask = self.node_mask.copy()
+            for row in range(self._capacity):
+                if not mask[row]:
+                    continue
+                labels = self._labels.get(row, {})
+                if any(labels.get(k) != v
+                       for k, v in label_selector.items()):
+                    mask[row] = False
+            return mask

@@ -1,0 +1,116 @@
+"""The scheduling contract: exact integer semantics shared by the CPU oracle
+and the TPU kernel.
+
+Reference parity: this encodes the semantics of upstream Ray's
+``HybridSchedulingPolicy`` (``src/ray/raylet/scheduling/policy/
+hybrid_scheduling_policy.cc``) and ``LeastResourceScorer``
+(``src/ray/raylet/scheduling/policy/scorer.h``), per SURVEY.md §2.5
+[reference mount empty — semantics re-derived from the survey's behavioral
+description, not copied from source].  BASELINE.json's north star requires the
+TPU backend to match the CPU policy bit-for-bit; everything in this module is
+therefore *pure integer arithmetic* with explicitly documented widths.
+
+Semantics (the contract)
+------------------------
+For a request ``r`` (dense int32 cu vector) against node ``n`` with totals
+``T_n`` and availables ``A_n``:
+
+* feasible(n)   = all(T_n[i] >= r[i] for r[i] > 0)
+* available(n)  = all(A_n[i] >= r[i] for r[i] > 0)
+* score(n)      = max over {i : r[i] > 0} of ((T_n[i] - A_n[i] + r[i]) * SCALE)
+                  // T_n[i]              -- critical-resource utilization,
+                  integer floor division, SCALE = 2**12.  Empty request => 0.
+* eff(n)        = 0 if (available(n) and score(n) < threshold_fp) else score(n)
+                  -- the hybrid pack/spread bucketing: below-threshold
+                  available nodes tie at 0 and fall to traversal order
+                  (packing); above it they rank by score (spreading).
+* key(n)        = (not available(n)) << 27 | eff(n) << 13 | traversal_index(n)
+                  if feasible(n) else INFEASIBLE_KEY
+* decision      = argmin over nodes of key(n); INFEASIBLE_KEY everywhere
+                  => infeasible (queue until the cluster changes).
+
+A placement on an *available* node decrements its availables by ``r``; a
+placement on a feasible-but-unavailable node queues (no decrement) — matching
+the reference's "best feasible node" fallback (SURVEY §2.5 item 4).
+
+Batch semantics: one scheduling round partitions the pending queue by
+scheduling class (identical (resources, strategy)) and processes classes in
+first-appearance order, tasks within a class in queue order.  This is faithful
+to the reference, whose ``ClusterTaskManager`` keys its schedule queue by
+``SchedulingClass`` and drains it class-by-class (SURVEY §3.2).
+
+Width audit (why int32 suffices end to end, incl. on TPU):
+    T, A, r      <= MAX_TOTAL_CU = 2**17
+    q = used + r <= 2 * 2**17 = 2**18
+    q * SCALE    <= 2**30 < 2**31 - 1          (the score numerator)
+    (L+1) * T    <= (2*SCALE + 1) * 2**17 < 2**30   (water-fill inversion)
+    key          <  2**28
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common.config import get_config
+
+SCORE_SCALE_BITS = 12
+SCALE = 1 << SCORE_SCALE_BITS          # 4096
+NODE_BITS = 13
+MAX_NODES = 1 << NODE_BITS             # 8192
+SCORE_SHIFT = NODE_BITS
+AVAIL_SHIFT = NODE_BITS + 14           # eff(n) <= 2*SCALE < 2**14
+INFEASIBLE_KEY = np.int32(2**31 - 1)
+MAX_SCORE = 2 * SCALE                  # score of a node at 2x utilization
+
+
+def threshold_fp(spread_threshold: float | None = None) -> int:
+    """Spread threshold in score fixed point."""
+    t = (get_config().scheduler_spread_threshold
+         if spread_threshold is None else spread_threshold)
+    return int(round(t * SCALE))
+
+
+def compute_keys(totals: np.ndarray, avail: np.ndarray, req: np.ndarray,
+                 thr_fp: int, node_mask: np.ndarray | None = None
+                 ) -> np.ndarray:
+    """Packed int32 keys for one request against all nodes (numpy, exact).
+
+    totals/avail: (N, R) int32 cu.  req: (R,) int32 cu.
+    node_mask: optional (N,) bool — False rows are treated as infeasible
+    (affinity/label constraints, dead nodes, padding rows).
+    Returns (N,) int32.
+    """
+    totals = np.asarray(totals, dtype=np.int64)
+    avail = np.asarray(avail, dtype=np.int64)
+    req = np.asarray(req, dtype=np.int64)
+    n = totals.shape[0]
+    req_pos = req > 0
+
+    if not req_pos.any():
+        feasible = np.ones(n, dtype=bool)
+        available = np.ones(n, dtype=bool)
+        score = np.zeros(n, dtype=np.int64)
+    else:
+        t = totals[:, req_pos]
+        a = avail[:, req_pos]
+        r = req[req_pos]
+        feasible = (t >= r).all(axis=1)
+        available = (a >= r).all(axis=1)
+        denom = np.where(t > 0, t, 1)
+        q = t - a + r
+        score = ((q * SCALE) // denom).max(axis=1)
+
+    eff = np.where(available & (score < thr_fp), 0, score)
+    key = ((~available).astype(np.int64) << AVAIL_SHIFT) \
+        | (eff << SCORE_SHIFT) | np.arange(n, dtype=np.int64)
+    key = np.where(feasible, key, np.int64(INFEASIBLE_KEY))
+    if node_mask is not None:
+        key = np.where(node_mask, key, np.int64(INFEASIBLE_KEY))
+    return key.astype(np.int32)
+
+
+def unpack_key(key: int) -> tuple[int, int, int]:
+    """(unavailable_bucket, eff_score, traversal_index) for debugging."""
+    return (int(key) >> AVAIL_SHIFT,
+            (int(key) >> SCORE_SHIFT) & ((1 << 14) - 1),
+            int(key) & (MAX_NODES - 1))

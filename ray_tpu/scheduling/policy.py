@@ -1,0 +1,176 @@
+"""The SchedulingPolicy plugin boundary and its stock policies.
+
+Reference parity: ``ISchedulingPolicy::Schedule(resource_request,
+SchedulingOptions)`` with implementations ``HybridSchedulingPolicy``,
+``SpreadSchedulingPolicy``, ``RandomSchedulingPolicy``,
+``NodeAffinitySchedulingPolicy``, ``NodeLabelSchedulingPolicy``, composed by
+``CompositeSchedulingPolicy`` (``src/ray/raylet/scheduling/policy/*``).
+[SURVEY.md §1 layer 5; mount empty.]  BASELINE.json gates the TPU backend
+behind exactly this boundary: the hybrid policy here can answer from the CPU
+oracle or defer batches to the device kernel — callers cannot tell which.
+
+Policies are pure functions of (ClusterState snapshot, request, options):
+no hidden state except the documented RNG/round-robin cursors, so parity is a
+property test (SURVEY §4 closing note).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+from .contract import (AVAIL_SHIFT, INFEASIBLE_KEY, compute_keys,
+                       threshold_fp)
+from .oracle import ClusterState
+
+
+class SchedulingType(enum.Enum):
+    HYBRID = 0
+    SPREAD = 1
+    RANDOM = 2
+    NODE_AFFINITY = 3
+
+
+@dataclass
+class SchedulingOptions:
+    """Mirror of the reference's SchedulingOptions variants."""
+
+    scheduling_type: SchedulingType = SchedulingType.HYBRID
+    spread_threshold: float | None = None      # None => config default
+    avoid_local_node: bool = False
+    require_node_available: bool = False
+    # NODE_AFFINITY
+    node_row: int = -1
+    soft: bool = False
+    # label constraints resolved by the caller into a node mask
+    node_mask: np.ndarray | None = None
+
+
+class ISchedulingPolicy:
+    def schedule(self, state: ClusterState, req: np.ndarray,
+                 options: SchedulingOptions) -> int:
+        """Return node row or -1. Must not mutate ``state`` unless the
+        placement consumes resources (available-bucket placements do)."""
+        raise NotImplementedError
+
+
+class HybridSchedulingPolicy(ISchedulingPolicy):
+    """The default policy — contract.py semantics (SURVEY §2.5)."""
+
+    def schedule(self, state, req, options):
+        thr = threshold_fp(options.spread_threshold)
+        mask = state.node_mask
+        if options.node_mask is not None:
+            mask = mask & options.node_mask
+        if options.avoid_local_node:
+            mask = mask.copy()
+            mask[0] = False
+        keys = compute_keys(state.totals, state.avail, req, thr, mask)
+        node = int(np.argmin(keys))
+        if keys[node] == INFEASIBLE_KEY:
+            return -1
+        available = (keys[node] >> AVAIL_SHIFT) == 0
+        if options.require_node_available and not available:
+            return -1
+        if available:
+            state.avail[node] -= np.asarray(req, dtype=np.int32)
+        return node
+
+
+class SpreadSchedulingPolicy(ISchedulingPolicy):
+    """Round-robin over feasible+available nodes (reference
+    ``SpreadSchedulingPolicy``: best-effort even spreading with a rotating
+    start cursor)."""
+
+    def __init__(self):
+        self._cursor = 0
+
+    def schedule(self, state, req, options):
+        thr = threshold_fp(options.spread_threshold)
+        mask = state.node_mask if options.node_mask is None \
+            else state.node_mask & options.node_mask
+        keys = compute_keys(state.totals, state.avail, req, thr, mask)
+        n = state.num_nodes
+        order = (np.arange(n) + self._cursor) % n
+        feasible = keys != INFEASIBLE_KEY
+        available = feasible & ((keys >> AVAIL_SHIFT) == 0)
+        for pool in (available, feasible):
+            cand = order[pool[order]]
+            if cand.size:
+                node = int(cand[0])
+                self._cursor = (node + 1) % n
+                if available[node]:
+                    state.avail[node] -= np.asarray(req, dtype=np.int32)
+                return node
+        return -1
+
+
+class RandomSchedulingPolicy(ISchedulingPolicy):
+    """Uniform over feasible+available nodes, pinned threefry stream so runs
+    replay deterministically (SURVEY §7 hard part 2)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.Generator(np.random.Philox(seed))
+
+    def schedule(self, state, req, options):
+        thr = threshold_fp(options.spread_threshold)
+        mask = state.node_mask if options.node_mask is None \
+            else state.node_mask & options.node_mask
+        keys = compute_keys(state.totals, state.avail, req, thr, mask)
+        available = (keys != INFEASIBLE_KEY) & ((keys >> AVAIL_SHIFT) == 0)
+        cand = np.flatnonzero(available)
+        if cand.size == 0:
+            cand = np.flatnonzero(keys != INFEASIBLE_KEY)
+            if cand.size == 0:
+                return -1
+            return int(self._rng.choice(cand))
+        node = int(self._rng.choice(cand))
+        state.avail[node] -= np.asarray(req, dtype=np.int32)
+        return node
+
+
+class NodeAffinitySchedulingPolicy(ISchedulingPolicy):
+    """Pin to a node; hard affinity fails if the node can't take it, soft
+    affinity falls back to hybrid (reference
+    ``NodeAffinitySchedulingPolicy``)."""
+
+    def __init__(self):
+        self._hybrid = HybridSchedulingPolicy()
+
+    def schedule(self, state, req, options):
+        row = options.node_row
+        ok = (0 <= row < state.num_nodes) and bool(state.node_mask[row])
+        if ok:
+            thr = threshold_fp(options.spread_threshold)
+            keys = compute_keys(state.totals, state.avail, req, thr,
+                                state.node_mask)
+            if keys[row] != INFEASIBLE_KEY:
+                if (keys[row] >> AVAIL_SHIFT) == 0:
+                    state.avail[row] -= np.asarray(req, dtype=np.int32)
+                return row
+        if options.soft:
+            fallback = SchedulingOptions(
+                scheduling_type=SchedulingType.HYBRID,
+                spread_threshold=options.spread_threshold,
+                node_mask=options.node_mask)
+            return self._hybrid.schedule(state, req, fallback)
+        return -1
+
+
+class CompositeSchedulingPolicy(ISchedulingPolicy):
+    """Dispatch on options.scheduling_type (reference
+    ``CompositeSchedulingPolicy``)."""
+
+    def __init__(self, seed: int = 0):
+        self._policies = {
+            SchedulingType.HYBRID: HybridSchedulingPolicy(),
+            SchedulingType.SPREAD: SpreadSchedulingPolicy(),
+            SchedulingType.RANDOM: RandomSchedulingPolicy(seed),
+            SchedulingType.NODE_AFFINITY: NodeAffinitySchedulingPolicy(),
+        }
+
+    def schedule(self, state, req, options):
+        return self._policies[options.scheduling_type].schedule(
+            state, req, options)

@@ -1,0 +1,64 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh (no multi-chip TPU hardware in
+CI): XLA_FLAGS/JAX_PLATFORMS must be set before jax initializes, hence the
+os.environ writes at import time.  Numerics in the scheduling contract are
+pure int32, so CPU results are bit-identical to TPU results by construction.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+from ray_tpu.common.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    Config.reset()
+    yield
+    Config.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def random_cluster(rng, n_nodes, n_resources, max_total_units=64):
+    """Random dense cluster state in cu with some zero-capacity columns."""
+    from ray_tpu.scheduling.oracle import ClusterState
+    totals = rng.integers(0, max_total_units * 100,
+                          size=(n_nodes, n_resources)).astype(np.int32)
+    # some nodes lack some resources entirely
+    totals[rng.random(totals.shape) < 0.2] = 0
+    used_frac = rng.random((n_nodes, n_resources))
+    avail = (totals * (1 - used_frac)).astype(np.int32)
+    return ClusterState(totals, avail)
+
+
+def random_requests(rng, n_tasks, n_resources, n_classes=8,
+                    max_req_units=8):
+    """Random request batch drawn from a small set of scheduling classes."""
+    classes = rng.integers(0, max_req_units * 100,
+                           size=(n_classes, n_resources)).astype(np.int32)
+    classes[rng.random(classes.shape) < 0.5] = 0
+    picks = rng.integers(0, n_classes, size=n_tasks)
+    return classes[picks]
+
+
+@pytest.fixture
+def make_cluster(rng):
+    return lambda *a, **k: random_cluster(rng, *a, **k)
+
+
+@pytest.fixture
+def make_requests(rng):
+    return lambda *a, **k: random_requests(rng, *a, **k)

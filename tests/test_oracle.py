@@ -1,0 +1,206 @@
+"""Semantics tests for the CPU oracle scheduler (the parity anchor).
+
+Scenario tests mirror the reference's C++ unit style
+(hybrid_scheduling_policy_test.cc / cluster_resource_scheduler_test.cc per
+SURVEY.md §4): construct synthetic NodeResources, assert the chosen node.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_cluster, random_requests
+from ray_tpu.scheduling import (ClusterState, SchedulingOptions,
+                                SchedulingType, CompositeSchedulingPolicy,
+                                HybridSchedulingPolicy, compute_keys,
+                                expand_group_counts, group_requests,
+                                schedule_grouped_oracle, schedule_one,
+                                schedule_tasks, threshold_fp, unpack_key,
+                                INFEASIBLE_KEY)
+
+
+def cu(units):
+    return int(units * 100)
+
+
+def state_of(*nodes):
+    """nodes: list of (total_units, avail_units) single-resource rows."""
+    totals = np.array([[cu(t)] for t, _ in nodes], dtype=np.int32)
+    avail = np.array([[cu(a)] for _, a in nodes], dtype=np.int32)
+    return ClusterState(totals, avail)
+
+
+class TestHybridSemantics:
+    def test_packs_below_threshold(self):
+        # Both nodes under 50% after placement -> tie at eff 0 -> first row.
+        st = state_of((8, 8), (8, 8))
+        req = np.array([cu(1)], dtype=np.int32)
+        assert schedule_one(st, req, threshold_fp(0.5)) == 0
+        # and keeps packing node 0 until it would cross the threshold
+        for _ in range(2):
+            assert schedule_one(st, req, threshold_fp(0.5)) == 0
+        assert st.avail[0, 0] == cu(5)
+
+    def test_spreads_above_threshold(self):
+        # Node0 at 60% after placement (above thr), node1 at 30%: spread.
+        st = state_of((10, 5), (10, 8))
+        req = np.array([cu(1)], dtype=np.int32)
+        # node0 score: (5+1)/10 = 0.6 > 0.5; node1: (2+1)/10=0.3 < 0.5 -> eff 0
+        assert schedule_one(st, req, threshold_fp(0.5)) == 1
+
+    def test_threshold_zero_always_ranks_by_score(self):
+        st = state_of((10, 9), (10, 10))
+        req = np.array([cu(1)], dtype=np.int32)
+        # thr=0: scores 0.2 vs 0.1 -> node1 despite traversal order
+        assert schedule_one(st, req, threshold_fp(0.0)) == 1
+
+    def test_feasible_but_unavailable_queues_without_consuming(self):
+        st = state_of((4, 0.5), (2, 0.25))
+        req = np.array([cu(1)], dtype=np.int32)
+        node = schedule_one(st, req, threshold_fp(0.5))
+        assert node in (0, 1)
+        # nothing consumed
+        assert st.avail[0, 0] == cu(0.5) and st.avail[1, 0] == cu(0.25)
+
+    def test_infeasible(self):
+        st = state_of((4, 4))
+        req = np.array([cu(8)], dtype=np.int32)
+        assert schedule_one(st, req, threshold_fp(0.5)) == -1
+
+    def test_missing_resource_is_infeasible(self):
+        totals = np.array([[cu(4), 0], [cu(4), cu(1)]], dtype=np.int32)
+        st = ClusterState(totals, totals.copy())
+        req = np.array([cu(1), cu(1)], dtype=np.int32)
+        assert schedule_one(st, req, threshold_fp(0.5)) == 1
+
+    def test_empty_request_goes_to_first_node(self):
+        st = state_of((4, 0), (4, 4))
+        req = np.array([0], dtype=np.int32)
+        assert schedule_one(st, req, threshold_fp(0.5)) == 0
+
+    def test_critical_resource_is_max_over_requested(self):
+        # node0: CPU util (2+1)/4=0.75, mem (1+1)/8=0.25 -> score 0.75
+        # node1: CPU util (1+1)/4=0.5, mem (6+1)/8=0.875 -> score 0.875
+        totals = np.array([[cu(4), cu(8)], [cu(4), cu(8)]], dtype=np.int32)
+        avail = np.array([[cu(2), cu(7)], [cu(3), cu(2)]], dtype=np.int32)
+        st = ClusterState(totals, avail)
+        req = np.array([cu(1), cu(1)], dtype=np.int32)
+        assert schedule_one(st, req, threshold_fp(0.0)) == 0
+
+    def test_node_mask_excludes(self):
+        st = state_of((8, 8), (8, 8))
+        req = np.array([cu(1)], dtype=np.int32)
+        mask = np.array([False, True])
+        assert schedule_one(st, req, threshold_fp(0.5), mask) == 1
+
+    def test_key_unpack(self):
+        st = state_of((10, 4))
+        req = np.array([cu(1)], dtype=np.int32)
+        keys = compute_keys(st.totals, st.avail, req, threshold_fp(0.5))
+        bucket, eff, trav = unpack_key(keys[0])
+        assert bucket == 0 and trav == 0
+        # score = (6+1)*4096//10 in cu terms: ((600+100)*4096)//1000
+        assert eff == ((cu(6) + cu(1)) * 4096) // cu(10)
+
+
+class TestSequentialBatch:
+    def test_fills_then_moves_on(self):
+        # capacity 2 tasks/node at 1 CPU; threshold 1.0 => pure packing
+        st = state_of((2, 2), (2, 2), (2, 2))
+        reqs = np.tile(np.array([[cu(1)]], dtype=np.int32), (6, 1))
+        placements = schedule_tasks(st, reqs, spread_threshold=1.01)
+        assert placements.tolist() == [0, 0, 1, 1, 2, 2]
+
+    def test_spread_when_above_threshold(self):
+        st = state_of((4, 4), (4, 4))
+        reqs = np.tile(np.array([[cu(1)]], dtype=np.int32), (4, 1))
+        # thr 0: rank by score -> alternate nodes
+        placements = schedule_tasks(st, reqs, spread_threshold=0.0)
+        assert placements.tolist() == [0, 1, 0, 1]
+
+    def test_overflow_queues_on_best_feasible(self):
+        st = state_of((2, 1), (4, 1))
+        reqs = np.tile(np.array([[cu(1)]], dtype=np.int32), (5, 1))
+        placements = schedule_tasks(st, reqs, spread_threshold=0.5)
+        # 2 fit (one per node); remaining 3 queue on one feasible node
+        assert (placements >= 0).all()
+        tail = placements[2:]
+        assert len(set(tail.tolist())) == 1
+
+
+class TestGroupedOracle:
+    @pytest.mark.parametrize("seed", range(5))
+    @pytest.mark.parametrize("thr", [0.0, 0.5, 1.01])
+    def test_grouped_equals_sequential_on_grouped_order(self, seed, thr):
+        rng = np.random.default_rng(seed)
+        st = random_cluster(rng, n_nodes=17, n_resources=4)
+        reqs = random_requests(rng, n_tasks=200, n_resources=4, n_classes=6)
+        group_reqs, group_counts, task_group = group_requests(reqs)
+
+        # sequential loop over the grouped order
+        st_a = st.copy()
+        seq_reqs = np.concatenate([
+            np.tile(group_reqs[g], (int(group_counts[g]), 1))
+            for g in range(group_reqs.shape[0])])
+        seq = schedule_tasks(st_a, seq_reqs, spread_threshold=thr)
+
+        # grouped oracle counts
+        st_b = st.copy()
+        counts = schedule_grouped_oracle(st_b, group_reqs, group_counts,
+                                         spread_threshold=thr)
+        np.testing.assert_array_equal(st_a.avail, st_b.avail)
+        # per-group histogram must match
+        n = st.num_nodes
+        off = 0
+        for g in range(group_reqs.shape[0]):
+            c = int(group_counts[g])
+            hist = np.bincount(np.where(seq[off:off + c] < 0, n,
+                                        seq[off:off + c]), minlength=n + 1)
+            np.testing.assert_array_equal(hist, counts[g])
+            off += c
+
+    def test_expand_counts(self):
+        counts = np.array([[2, 0, 1], [0, 1, 0]], dtype=np.int32)  # N=2
+        task_group = np.array([0, 0, 0, 1], dtype=np.int32)
+        out = expand_group_counts(counts, task_group)
+        assert out.tolist() == [0, 0, -1, 1]
+
+
+class TestPolicies:
+    def test_spread_round_robins(self):
+        policy = CompositeSchedulingPolicy()
+        st = state_of((8, 8), (8, 8), (8, 8))
+        req = np.array([cu(1)], dtype=np.int32)
+        opts = SchedulingOptions(scheduling_type=SchedulingType.SPREAD)
+        got = [policy.schedule(st, req, opts) for _ in range(6)]
+        assert got == [0, 1, 2, 0, 1, 2]
+
+    def test_node_affinity_hard_and_soft(self):
+        policy = CompositeSchedulingPolicy()
+        st = state_of((8, 8), (8, 8))
+        req = np.array([cu(16)], dtype=np.int32)
+        hard = SchedulingOptions(
+            scheduling_type=SchedulingType.NODE_AFFINITY, node_row=1)
+        assert policy.schedule(st, req, hard) == -1
+        req2 = np.array([cu(1)], dtype=np.int32)
+        assert policy.schedule(st, req2, hard) == 1
+        soft = SchedulingOptions(
+            scheduling_type=SchedulingType.NODE_AFFINITY, node_row=5,
+            soft=True)
+        assert policy.schedule(st, req2, soft) == 0
+
+    def test_random_is_deterministic_per_seed(self):
+        st = state_of((8, 8), (8, 8), (8, 8), (8, 8))
+        req = np.array([cu(1)], dtype=np.int32)
+        opts = SchedulingOptions(scheduling_type=SchedulingType.RANDOM)
+        a = [CompositeSchedulingPolicy(seed=7).schedule(st.copy(), req, opts)
+             for _ in range(3)]
+        b = [CompositeSchedulingPolicy(seed=7).schedule(st.copy(), req, opts)
+             for _ in range(3)]
+        assert a == b
+
+    def test_hybrid_require_available(self):
+        policy = HybridSchedulingPolicy()
+        st = state_of((4, 0.5))
+        req = np.array([cu(1)], dtype=np.int32)
+        opts = SchedulingOptions(require_node_available=True)
+        assert policy.schedule(st, req, opts) == -1
