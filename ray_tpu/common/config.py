@@ -59,10 +59,6 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 100,
         "Resource-view sync period (reference: "
         "raylet_report_resources_period_milliseconds)."),
-    "scheduler_max_nodes": (
-        int, 8192,
-        "Device key packing supports at most 2**13 nodes (traversal index "
-        "bit width in the packed lexicographic key)."),
     "scheduler_device_backend": (
         bool, True,
         "Evaluate batched placement on the TPU kernel; False forces the CPU "
@@ -101,10 +97,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 256,
         "Budget for pinned task specs kept for lineage reconstruction."),
     # -- device -------------------------------------------------------------
-    "tpu_score_scale_bits": (
-        int, 12,
-        "Fixed-point score scale (SCALE = 2**bits). Part of the scheduling "
-        "contract: CPU oracle and TPU kernel share it bit-for-bit."),
+    # (score scale and max node count are compile-time contract constants in
+    # scheduling/contract.py — SCALE, MAX_NODES — not runtime knobs: the key
+    # bit layout depends on them.)
     "tpu_group_capacity": (
         int, 128,
         "Padded number of distinct scheduling classes per device batch."),

@@ -1,0 +1,3 @@
+from .hybrid_kernel import schedule_grouped, schedule_grouped_np
+
+__all__ = ["schedule_grouped", "schedule_grouped_np"]

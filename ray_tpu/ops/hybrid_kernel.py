@@ -1,0 +1,189 @@
+"""The TPU scheduling kernel: batched hybrid placement as dense device math.
+
+This is BASELINE.json's north star: the per-heartbeat batch of pending tasks
+evaluated as one dense (tasks x nodes x resources) computation — feasibility
+mask + critical-resource-utilization score + pack/spread tie-break — instead
+of the reference's per-task ``HybridSchedulingPolicy::Schedule`` calls inside
+the raylet event loop (``src/ray/raylet/scheduling/policy/
+hybrid_scheduling_policy.cc``, invoked from
+``ClusterTaskManager::ScheduleAndDispatchTasks`` — SURVEY.md §3.2 hot loop;
+reference mount empty, semantics re-derived in scheduling/contract.py).
+
+Why not lax.scan over tasks?  Sequential semantics (task t+1 sees resources
+consumed by task t) would serialize 1M tiny steps — SURVEY §7 hard part 1.
+The resolution implemented here:
+
+1.  Tasks are grouped by scheduling class (identical demand vector).  The
+    reference itself drains its scheduling queue class-by-class, so this is
+    semantics-preserving, not an approximation.
+2.  Within one class, sequential greedy placement onto min-key nodes is a
+    *merge of per-node non-decreasing key sequences*: placing on the argmin
+    node only raises that node's key.  The final per-node placement counts
+    are therefore a water-fill: find the smallest key level L* such that the
+    total number of placement slots with key <= L* covers the group, take
+    every slot strictly below L*, and hand out the remaining slots at level
+    L* in traversal order (the contract's tie-break).  The per-node slot
+    count at level L has a closed integer form because the score is an
+    integer-linear function of the placement index j:
+
+        s(j)   = max_i ((used_i + (j+1) r_i) * S) // T_i
+        s(j)<=L  ⟺  ∀i: used_i + (j+1) r_i) * S < (L+1) T_i
+                 ⟺  j+1 <= ((L+1) T_i - used_i S - 1) // (r_i S)
+
+    so "slots with key <= L" is a vectorized O(N*R) expression and L* is a
+    14-step integer binary search — no data-dependent iteration counts, no
+    dynamic shapes, everything jit-compiles to one XLA program.
+3.  Groups run under one lax.scan carrying ``avail`` — G steps (number of
+    distinct scheduling classes, typically tens), not T steps (tasks).
+
+All arithmetic is int32 with the width audit in scheduling/contract.py, so
+results are bit-identical to the numpy oracle on any backend.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scheduling.contract import AVAIL_SHIFT, SCALE, SCORE_SHIFT
+
+# Python ints (folded into the program as literals), NOT jnp scalars: a
+# closure-captured device buffer — even a scalar — drops the axon TPU
+# backend into a ~70ms/call synchronous slow mode for the whole process.
+_BIG = 1 << 30
+_INF_KEY = 2**31 - 1
+
+
+def _keys_one_req(totals, avail, req, thr_fp, mask):
+    """Packed int32 keys of one request vs all nodes (device twin of
+    contract.compute_keys)."""
+    n = totals.shape[0]
+    req_pos = req > 0
+    t = totals
+    a = avail
+    feas = jnp.all(jnp.where(req_pos[None, :], t >= req[None, :], True),
+                   axis=1) & mask
+    availb = jnp.all(jnp.where(req_pos[None, :], a >= req[None, :], True),
+                     axis=1)
+    denom = jnp.maximum(t, 1)
+    q = t - a + req[None, :]
+    s = jnp.where(req_pos[None, :], (q * SCALE) // denom, 0).max(
+        axis=1, initial=0)
+    eff = jnp.where(availb & (s < thr_fp), 0, s)
+    key = ((~availb).astype(jnp.int32) << AVAIL_SHIFT) \
+        | (eff << SCORE_SHIFT) | jnp.arange(n, dtype=jnp.int32)
+    return jnp.where(feas, key, _INF_KEY)
+
+
+def _slots_at_or_below(L, totals, used, req, req_pos, m_max, thr_fp):
+    """m_n(L): per-node count of placement slots with eff-score key <= L.
+
+    Threshold collapse: levels below thr_fp all equal the level-0 count
+    (eff score of a sub-threshold available slot is 0).
+    """
+    Lp = jnp.where(L < thr_fp, thr_fp - 1, L)
+    num = (Lp + 1) * totals - used * SCALE - 1          # (N, R)
+    denom = jnp.maximum(req * SCALE, 1)[None, :]
+    jc = jnp.clip(num // denom, 0, _BIG)
+    jcount = jnp.where(req_pos[None, :], jc, _BIG).min(axis=1)
+    return jnp.minimum(m_max, jcount)
+
+
+def _schedule_group(avail, totals, node_mask, req, count, gmask, thr_fp):
+    """Place ``count`` identical requests; returns (counts_row (N+1,),
+    new_avail)."""
+    n = totals.shape[0]
+    req_pos = req > 0
+    any_req = req_pos.any()
+    used = totals - avail
+
+    feas = jnp.all(jnp.where(req_pos[None, :], totals >= req[None, :], True),
+                   axis=1) & node_mask & gmask
+    caps = jnp.where(req_pos[None, :],
+                     avail // jnp.maximum(req, 1)[None, :], _BIG)
+    m_max = jnp.where(feas & any_req, jnp.clip(caps.min(axis=1), 0, _BIG), 0)
+
+    total_cap = m_max.sum()
+    n_avail = jnp.minimum(count, total_cap)     # placements that consume
+    overflow = count - n_avail                  # queue on best feasible
+
+    m_of = partial(_slots_at_or_below, totals=totals, used=used, req=req,
+                   req_pos=req_pos, m_max=m_max, thr_fp=thr_fp)
+
+    # binary search smallest L in [0, 2*SCALE] with sum(m(L)) >= n_avail
+    def bisect(carry, _):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        ok = m_of(mid).sum() >= n_avail
+        return (jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)), None
+
+    (l_star, _), _ = jax.lax.scan(
+        bisect, (jnp.int32(0), jnp.int32(2 * SCALE)), None,
+        length=SCALE.bit_length() + 2)
+
+    base = jnp.where(l_star > 0, m_of(jnp.maximum(l_star - 1, 0)), 0)
+    at_level = m_of(l_star)
+    extra = at_level - base
+    rem = n_avail - base.sum()
+    prefix = jnp.cumsum(extra) - extra          # exclusive, traversal order
+    give = jnp.clip(rem - prefix, 0, extra)
+    alloc = base + give                         # (N,) placements that consume
+
+    new_avail = avail - alloc[:, None] * req[None, :]
+
+    # overflow: all remaining tasks queue on the single best feasible node
+    # computed on the post-allocation state (sequential semantics: once no
+    # node is available, keys stop changing, so the argmin repeats).
+    okeys = _keys_one_req(totals, new_avail, req, thr_fp, node_mask & gmask)
+    onode = jnp.argmin(okeys).astype(jnp.int32)
+    infeasible = okeys[onode] == _INF_KEY
+    ocol = jnp.where(infeasible, n, onode)
+
+    counts_row = jnp.zeros(n + 1, jnp.int32).at[:n].set(alloc)
+    counts_row = counts_row.at[ocol].add(overflow)
+    return counts_row, new_avail
+
+
+@partial(jax.jit, static_argnames=("unroll",))
+def schedule_grouped(totals, avail, node_mask, group_reqs, group_counts,
+                     group_masks, thr_fp, unroll: int = 1):
+    """Batch-schedule G scheduling classes over N nodes on device.
+
+    totals/avail: (N, R) int32 cu.  node_mask: (N,) bool.
+    group_reqs: (G, R) int32.  group_counts: (G,) int32 (0 = padding row).
+    group_masks: (G, N) bool (per-class affinity/label restriction).
+    thr_fp: int32 scalar spread threshold in score fixed point.
+
+    Returns (counts (G, N+1) int32, new_avail (N, R) int32).  Column N
+    counts infeasible tasks.  Bit-identical to
+    scheduling.oracle.schedule_grouped_oracle by construction.
+    """
+    def step(avail, xs):
+        req, count, gmask = xs
+        row, new_avail = _schedule_group(avail, totals, node_mask, req,
+                                         count, gmask, thr_fp)
+        return new_avail, row
+
+    new_avail, counts = jax.lax.scan(
+        step, avail, (group_reqs, group_counts, group_masks), unroll=unroll)
+    return counts, new_avail
+
+
+def schedule_grouped_np(totals, avail, node_mask, group_reqs, group_counts,
+                        group_masks=None, thr_fp=None, spread_threshold=None):
+    """Convenience host wrapper: numpy in/out, device compute."""
+    from ..scheduling.contract import threshold_fp
+    if thr_fp is None:
+        thr_fp = threshold_fp(spread_threshold)
+    g, n = group_reqs.shape[0], totals.shape[0]
+    if group_masks is None:
+        group_masks = np.ones((g, n), dtype=bool)
+    counts, new_avail = schedule_grouped(
+        jnp.asarray(totals, jnp.int32), jnp.asarray(avail, jnp.int32),
+        jnp.asarray(node_mask, bool), jnp.asarray(group_reqs, jnp.int32),
+        jnp.asarray(group_counts, jnp.int32), jnp.asarray(group_masks, bool),
+        jnp.int32(thr_fp))
+    return np.asarray(counts), np.asarray(new_avail)

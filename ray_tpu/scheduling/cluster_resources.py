@@ -80,14 +80,14 @@ class ClusterResourceManager:
         free = np.flatnonzero(~self.node_mask)
         # prefer rows never used / lowest index: deterministic traversal order
         if free.size == 0:
-            if self._capacity * 2 > MAX_NODES:
+            if self._capacity >= MAX_NODES:
                 raise RuntimeError(f"cluster exceeds MAX_NODES={MAX_NODES}")
             self._grow()
             free = np.flatnonzero(~self.node_mask)
         return int(free[0])
 
     def _grow(self):
-        cap = self._capacity * 2
+        cap = min(self._capacity * 2, MAX_NODES)
         for name in ("totals", "avail"):
             arr = getattr(self, name)
             new = np.zeros((cap, self._r_slots), dtype=np.int32)
@@ -100,7 +100,7 @@ class ClusterResourceManager:
 
     def _col(self, name: str) -> int:
         col = self.resource_index.get_or_add(name)
-        if col >= self._r_slots:
+        while col >= self._r_slots:
             new = np.zeros((self._capacity, self._r_slots * 2), dtype=np.int32)
             new[:, :self._r_slots] = self.totals
             self.totals = new
@@ -109,6 +109,13 @@ class ClusterResourceManager:
             self.avail = new_a
             self._r_slots *= 2
         return col
+
+    def _dense_req(self, req: ResourceRequest) -> np.ndarray:
+        """Dense cu vector, growing the resource slots to cover the request
+        (ResourceRequest.dense interns names but cannot grow our arrays)."""
+        for name in req.cu():
+            self._col(name)
+        return req.dense(self.resource_index, self._r_slots)
 
     # -- sync from heartbeats (ray_syncer analogue, SURVEY §2.1) ------------
     def update_node_available(self, node_id: NodeID,
@@ -124,7 +131,7 @@ class ClusterResourceManager:
     # -- allocation (used by the dispatch path) -----------------------------
     def subtract(self, row: int, req: ResourceRequest) -> bool:
         with self._lock:
-            vec = req.dense(self.resource_index, self._r_slots)
+            vec = self._dense_req(req)
             if (self.avail[row] < vec).any():
                 return False
             self.avail[row] -= vec
@@ -133,7 +140,7 @@ class ClusterResourceManager:
 
     def add_back(self, row: int, req: ResourceRequest) -> None:
         with self._lock:
-            vec = req.dense(self.resource_index, self._r_slots)
+            vec = self._dense_req(req)
             self.avail[row] = np.minimum(self.totals[row],
                                          self.avail[row] + vec)
             self.version += 1

@@ -40,6 +40,7 @@ class SchedulingOptions:
     scheduling_type: SchedulingType = SchedulingType.HYBRID
     spread_threshold: float | None = None      # None => config default
     avoid_local_node: bool = False
+    local_node_row: int = 0                    # row of the scheduling raylet
     require_node_available: bool = False
     # NODE_AFFINITY
     node_row: int = -1
@@ -64,9 +65,10 @@ class HybridSchedulingPolicy(ISchedulingPolicy):
         mask = state.node_mask
         if options.node_mask is not None:
             mask = mask & options.node_mask
-        if options.avoid_local_node:
+        if options.avoid_local_node and \
+                0 <= options.local_node_row < mask.shape[0]:
             mask = mask.copy()
-            mask[0] = False
+            mask[options.local_node_row] = False
         keys = compute_keys(state.totals, state.avail, req, thr, mask)
         node = int(np.argmin(keys))
         if keys[node] == INFEASIBLE_KEY:

@@ -1,0 +1,112 @@
+"""Bit-for-bit parity: TPU water-fill kernel vs CPU sequential oracle.
+
+The north-star acceptance property (BASELINE.json): batched device placement
+must equal the CPU hybrid policy's sequential decisions exactly.  Arithmetic
+on both sides is pure int32, so these tests assert *equality*, not closeness.
+Runs on the virtual CPU backend in CI (conftest); the same int32 programs
+produce identical bits on real TPU hardware (exercised by bench.py).
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_cluster, random_requests
+from ray_tpu.ops import schedule_grouped_np
+from ray_tpu.scheduling import (group_requests, schedule_grouped_oracle,
+                                threshold_fp)
+
+
+def run_both(state, group_reqs, group_counts, thr, group_masks=None):
+    st = state.copy()
+    want = schedule_grouped_oracle(st, group_reqs, group_counts,
+                                   spread_threshold=thr,
+                                   group_masks=group_masks)
+    got, new_avail = schedule_grouped_np(
+        state.totals, state.avail, state.node_mask, group_reqs, group_counts,
+        group_masks, spread_threshold=thr)
+    np.testing.assert_array_equal(got, want, err_msg="placement counts")
+    np.testing.assert_array_equal(new_avail, st.avail, err_msg="avail")
+    return got
+
+
+@pytest.mark.parametrize("seed", range(20))
+@pytest.mark.parametrize("thr", [0.0, 0.3, 0.5, 1.01])
+def test_random_parity(seed, thr):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(1, 50))
+    n_res = int(rng.integers(1, 6))
+    n_tasks = int(rng.integers(1, 400))
+    state = random_cluster(rng, n_nodes, n_res)
+    reqs = random_requests(rng, n_tasks, n_res,
+                           n_classes=int(rng.integers(1, 9)))
+    group_reqs, group_counts, _ = group_requests(reqs)
+    run_both(state, group_reqs, group_counts, thr)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_parity_with_group_masks(seed):
+    rng = np.random.default_rng(100 + seed)
+    state = random_cluster(rng, 23, 3)
+    reqs = random_requests(rng, 150, 3, n_classes=5)
+    group_reqs, group_counts, _ = group_requests(reqs)
+    masks = rng.random((group_reqs.shape[0], 23)) < 0.6
+    run_both(state, group_reqs, group_counts, 0.5, masks)
+
+
+def test_empty_request_class(rng):
+    state = random_cluster(rng, 9, 3)
+    group_reqs = np.zeros((1, 3), dtype=np.int32)
+    group_counts = np.array([17], dtype=np.int32)
+    counts = run_both(state, group_reqs, group_counts, 0.5)
+    assert counts[0].sum() == 17
+
+
+def test_all_infeasible(rng):
+    state = random_cluster(rng, 5, 2)
+    group_reqs = np.full((1, 2), 10**6, dtype=np.int32)
+    group_counts = np.array([13], dtype=np.int32)
+    counts = run_both(state, group_reqs, group_counts, 0.5)
+    assert counts[0, -1] == 13          # all in the infeasible column
+
+
+def test_overflow_queues_on_single_node(rng):
+    # demand exceeds total cluster capacity: overflow all lands on one node
+    totals = np.full((6, 1), 400, dtype=np.int32)   # 4 units each
+    state_avail = totals.copy()
+    from ray_tpu.scheduling import ClusterState
+    state = ClusterState(totals, state_avail)
+    group_reqs = np.array([[100]], dtype=np.int32)  # 1 unit
+    group_counts = np.array([100], dtype=np.int32)  # 24 fit, 76 queue
+    counts = run_both(state, group_reqs, group_counts, 0.5)
+    placed = counts[0, :-1]
+    assert placed.sum() == 100
+    assert (placed >= 4).sum() == 6                 # every node filled
+    assert placed.max() == 4 + 76                   # the rest queue on one
+
+
+def test_padding_rows_are_noops(rng):
+    state = random_cluster(rng, 12, 3)
+    reqs = random_requests(rng, 60, 3, n_classes=3)
+    group_reqs, group_counts, _ = group_requests(reqs)
+    # pad with zero-count rows (the fixed-shape device batch)
+    pad = 5
+    gr = np.vstack([group_reqs, np.ones((pad, 3), np.int32)])
+    gc = np.concatenate([group_counts, np.zeros(pad, np.int32)])
+    got = run_both(state, gr, gc, 0.5)
+    assert (got[-pad:] == 0).all()
+
+
+def test_thousand_node_smoke():
+    rng = np.random.default_rng(7)
+    state = random_cluster(rng, 1000, 4)
+    reqs = random_requests(rng, 5000, 4, n_classes=16)
+    group_reqs, group_counts, _ = group_requests(reqs)
+    got, _ = schedule_grouped_np(
+        state.totals, state.avail, state.node_mask, group_reqs, group_counts,
+        spread_threshold=0.5)
+    assert got.sum() == 5000
+    # cross-check a couple of groups against the oracle
+    st = state.copy()
+    want = schedule_grouped_oracle(st, group_reqs, group_counts,
+                                   spread_threshold=0.5)
+    np.testing.assert_array_equal(got, want)
