@@ -8,11 +8,19 @@ pure int32, so CPU results are bit-identical to TPU results by construction.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize force-registers the TPU backend at interpreter
+# start (jax_platforms="axon,cpu"); override it BEFORE any backend init so
+# tests really run on the virtual 8-device CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np
 import pytest
