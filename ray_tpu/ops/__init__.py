@@ -1,3 +1,5 @@
+from .bundle_kernel import schedule_bundle_groups, schedule_bundle_groups_np
 from .hybrid_kernel import schedule_grouped, schedule_grouped_np
 
-__all__ = ["schedule_grouped", "schedule_grouped_np"]
+__all__ = ["schedule_bundle_groups", "schedule_bundle_groups_np",
+           "schedule_grouped", "schedule_grouped_np"]

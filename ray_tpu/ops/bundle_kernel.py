@@ -1,0 +1,137 @@
+"""TPU gang-placement kernel: placement-group bundles as device math.
+
+Device twin of ``ray_tpu/scheduling/bundles.py`` (the CPU oracle — see its
+docstring for the contract and the reference citations:
+``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc`` invoked from
+``GcsPlacementGroupScheduler::ScheduleUnplacedBundles``, SURVEY.md §3.5;
+mount empty, semantics re-derived).
+
+Shape discipline: a batch of P placement groups, each padded to B bundle
+slots over R resources — ``(P, B, R)`` requests + ``(P, B)`` validity +
+``(P,)`` strategy codes.  The outer ``lax.scan`` carries ``avail`` so group
+p+1 sees group p's reservations (sequential semantics); each group is
+atomic — its bundle placements apply to the carry only if every valid bundle
+found an available node.  The inner bundle loop is a second ``lax.scan``
+(B is small: gang sizes are tens, not thousands).
+
+Width note: a STRICT_PACK group sums its bundle demands; the sum is clamped
+to ``MAX_TOTAL_CU + 1`` — any value above every node's per-resource total
+(the int32 contract caps totals at MAX_TOTAL_CU) is equivalently infeasible,
+and the clamp keeps ``(t - a + req) * SCALE`` inside int32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.resources import MAX_TOTAL_CU
+from ..scheduling.bundles import PlacementStrategy
+from ..scheduling.contract import AVAIL_SHIFT
+from .hybrid_kernel import _INF_KEY, _keys_one_req
+
+_PACK = PlacementStrategy.PACK.value
+_STRICT_PACK = PlacementStrategy.STRICT_PACK.value
+_STRICT_SPREAD = PlacementStrategy.STRICT_SPREAD.value
+
+
+def _avail_keys(totals, avail, req, thr_fp, mask):
+    """Hybrid keys with feasible-but-unavailable nodes forced to INF
+    (bundle reservation consumes resources — availability is a hard
+    requirement, unlike task scheduling's queue-on-feasible)."""
+    keys = _keys_one_req(totals, avail, req, thr_fp, mask)
+    return jnp.where((keys >> AVAIL_SHIFT) & 1 == 0, keys, _INF_KEY)
+
+
+def _place_soft(avail, totals, node_mask, reqs, valid, strategy, thr_fp):
+    """PACK / SPREAD / STRICT_SPREAD: bundle-at-a-time scan."""
+
+    def step(carry, xs):
+        avail, used, ok = carry
+        req, v = xs
+        primary = jnp.where(strategy == _PACK, used, ~used) & node_mask
+        k1 = _avail_keys(totals, avail, req, thr_fp, primary)
+        n1 = jnp.argmin(k1).astype(jnp.int32)
+        ok1 = k1[n1] != _INF_KEY
+        k2 = _avail_keys(totals, avail, req, thr_fp, node_mask)
+        n2 = jnp.argmin(k2).astype(jnp.int32)
+        ok2 = k2[n2] != _INF_KEY
+        use_fb = (strategy != _STRICT_SPREAD) & ~ok1
+        node = jnp.where(ok1, n1, n2)
+        found = ok1 | (use_fb & ok2)
+        place = found & v
+        avail = avail.at[node].add(
+            jnp.where(place, -req, 0), mode="drop")
+        used = used.at[node].set(used[node] | place, mode="drop")
+        ok = ok & (found | ~v)
+        row = jnp.where(place, node, -1)
+        return (avail, used, ok), row
+
+    used0 = jnp.zeros(totals.shape[0], dtype=bool)
+    (new_avail, _, ok), rows = jax.lax.scan(
+        step, (avail, used0, jnp.bool_(True)), (reqs, valid))
+    return rows, ok, new_avail
+
+
+def _place_strict_pack(avail, totals, node_mask, reqs, valid, thr_fp):
+    total = jnp.where(valid[:, None], reqs, 0).sum(axis=0)
+    total = jnp.minimum(total, MAX_TOTAL_CU + 1)   # width clamp, see module doc
+    keys = _avail_keys(totals, avail, total, thr_fp, node_mask)
+    node = jnp.argmin(keys).astype(jnp.int32)
+    ok = keys[node] != _INF_KEY
+    rows = jnp.where(valid & ok, node, -1)
+    new_avail = avail.at[node].add(jnp.where(ok, -total, 0), mode="drop")
+    return rows, ok, new_avail
+
+
+@jax.jit
+def schedule_bundle_groups(totals, avail, node_mask, bundle_reqs,
+                           bundle_valid, strategies, thr_fp):
+    """Atomically place P padded placement groups on device.
+
+    totals/avail: (N, R) int32 cu.  node_mask: (N,) bool.
+    bundle_reqs: (P, B, R) int32.  bundle_valid: (P, B) bool.
+    strategies: (P,) int32 PlacementStrategy codes.  thr_fp: int32 scalar.
+
+    Returns (rows (P, B) int32 node rows, -1 for padded/failed bundles;
+             ok (P,) bool per-group success; new_avail (N, R)).
+    Groups run in index order; a failed group leaves ``avail`` untouched.
+    Bit-identical to bundles.schedule_bundles applied sequentially.
+    """
+
+    def group_step(avail, xs):
+        reqs, valid, strategy = xs
+        rows_s, ok_s, avail_s = _place_soft(
+            avail, totals, node_mask, reqs, valid, strategy, thr_fp)
+        rows_p, ok_p, avail_p = _place_strict_pack(
+            avail, totals, node_mask, reqs, valid, thr_fp)
+        is_sp = strategy == _STRICT_PACK
+        rows = jnp.where(is_sp, rows_p, rows_s)
+        ok = jnp.where(is_sp, ok_p, ok_s)
+        new_avail = jnp.where(is_sp, avail_p, avail_s)
+        new_avail = jnp.where(ok, new_avail, avail)    # atomicity
+        rows = jnp.where(ok, rows, -1)
+        return new_avail, (rows, ok)
+
+    new_avail, (rows, ok) = jax.lax.scan(
+        group_step, avail, (bundle_reqs, bundle_valid, strategies))
+    return rows, ok, new_avail
+
+
+def schedule_bundle_groups_np(totals, avail, node_mask, bundle_reqs,
+                              bundle_valid, strategies, thr_fp=None,
+                              spread_threshold=None):
+    """Host wrapper: numpy in/out, device compute."""
+    from ..scheduling.contract import threshold_fp
+    if thr_fp is None:
+        thr_fp = threshold_fp(spread_threshold)
+    strat = np.asarray(
+        [s.value if isinstance(s, PlacementStrategy) else int(s)
+         for s in strategies], dtype=np.int32)
+    rows, ok, new_avail = schedule_bundle_groups(
+        jnp.asarray(totals, jnp.int32), jnp.asarray(avail, jnp.int32),
+        jnp.asarray(node_mask, bool), jnp.asarray(bundle_reqs, jnp.int32),
+        jnp.asarray(bundle_valid, bool), jnp.asarray(strat),
+        jnp.int32(thr_fp))
+    return np.asarray(rows), np.asarray(ok), np.asarray(new_avail)

@@ -1,3 +1,4 @@
+from .bundles import PlacementStrategy, schedule_bundles
 from .cluster_resources import ClusterResourceManager
 from .contract import (AVAIL_SHIFT, INFEASIBLE_KEY, MAX_NODES, SCALE,
                        compute_keys, threshold_fp, unpack_key)
@@ -9,6 +10,7 @@ from .policy import (CompositeSchedulingPolicy, HybridSchedulingPolicy,
                      SchedulingType, SpreadSchedulingPolicy)
 
 __all__ = [
+    "PlacementStrategy", "schedule_bundles",
     "ClusterResourceManager", "ClusterState", "CompositeSchedulingPolicy",
     "HybridSchedulingPolicy", "ISchedulingPolicy", "INFEASIBLE_KEY",
     "MAX_NODES", "NodeAffinitySchedulingPolicy", "RandomSchedulingPolicy",
