@@ -1,0 +1,5 @@
+from .demand import (FIRST_FIT_THRESHOLD, NodeTypeSpec, fit_existing,
+                     get_nodes_to_launch, pack_one_node)
+
+__all__ = ["FIRST_FIT_THRESHOLD", "NodeTypeSpec", "fit_existing",
+           "get_nodes_to_launch", "pack_one_node"]

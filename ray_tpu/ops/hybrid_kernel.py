@@ -92,7 +92,8 @@ def _slots_at_or_below(L, totals, used, req, req_pos, m_max, thr_fp):
     return jnp.minimum(m_max, jcount)
 
 
-def _schedule_group(avail, totals, node_mask, req, count, gmask, thr_fp):
+def _schedule_group(avail, totals, node_mask, req, count, gmask, thr_fp,
+                    require_available=False):
     """Place ``count`` identical requests; returns (counts_row (N+1,),
     new_avail)."""
     n = totals.shape[0]
@@ -141,15 +142,24 @@ def _schedule_group(avail, totals, node_mask, req, count, gmask, thr_fp):
     onode = jnp.argmin(okeys).astype(jnp.int32)
     infeasible = okeys[onode] == _INF_KEY
     ocol = jnp.where(infeasible, n, onode)
+    if require_available:
+        # autoscaler fit semantics: feasible-but-unavailable overflow counts
+        # as leftover (column n), never queued (oracle require_available
+        # flag).  Overflow on an AVAILABLE node still places: that only
+        # happens for empty requests, which consume nothing and are always
+        # available (capacity never exhausts them into the overflow branch).
+        o_avail = (okeys[onode] >> AVAIL_SHIFT) & 1 == 0
+        ocol = jnp.where(infeasible | ~o_avail, n, onode)
 
     counts_row = jnp.zeros(n + 1, jnp.int32).at[:n].set(alloc)
     counts_row = counts_row.at[ocol].add(overflow)
     return counts_row, new_avail
 
 
-@partial(jax.jit, static_argnames=("unroll",))
+@partial(jax.jit, static_argnames=("unroll", "require_available"))
 def schedule_grouped(totals, avail, node_mask, group_reqs, group_counts,
-                     group_masks, thr_fp, unroll: int = 1):
+                     group_masks, thr_fp, unroll: int = 1,
+                     require_available: bool = False):
     """Batch-schedule G scheduling classes over N nodes on device.
 
     totals/avail: (N, R) int32 cu.  node_mask: (N,) bool.
@@ -164,7 +174,8 @@ def schedule_grouped(totals, avail, node_mask, group_reqs, group_counts,
     def step(avail, xs):
         req, count, gmask = xs
         row, new_avail = _schedule_group(avail, totals, node_mask, req,
-                                         count, gmask, thr_fp)
+                                         count, gmask, thr_fp,
+                                         require_available)
         return new_avail, row
 
     new_avail, counts = jax.lax.scan(

@@ -49,12 +49,13 @@ class ClusterState:
 
 def schedule_one(state: ClusterState, req: np.ndarray,
                  thr_fp: int, extra_mask: np.ndarray | None = None,
-                 commit: bool = True) -> int:
+                 commit: bool = True, require_available: bool = False) -> int:
     """Schedule a single request. Returns node row or -1 (infeasible).
 
     Decrements ``state.avail`` iff the chosen node is available and
     ``commit`` — feasible-but-unavailable placements queue without consuming
-    (contract; reference behavior per SURVEY §2.5 item 4).
+    (contract; reference behavior per SURVEY §2.5 item 4), unless
+    ``require_available``, in which case they return -1.
     """
     mask = state.node_mask if extra_mask is None \
         else (state.node_mask & extra_mask)
@@ -62,9 +63,10 @@ def schedule_one(state: ClusterState, req: np.ndarray,
     node = int(np.argmin(keys))
     if keys[node] == INFEASIBLE_KEY:
         return -1
-    if commit and (keys[node] >> AVAIL_SHIFT) == 0:  # available bucket
-        req_i = np.asarray(req, dtype=np.int32)
-        state.avail[node] -= req_i
+    if (keys[node] >> AVAIL_SHIFT) != 0:             # best is unavailable
+        return -1 if require_available else node
+    if commit:
+        state.avail[node] -= np.asarray(req, dtype=np.int32)
     return node
 
 
@@ -115,13 +117,17 @@ def group_requests(reqs: np.ndarray, masks: np.ndarray | None = None
 def schedule_grouped_oracle(state: ClusterState, group_reqs: np.ndarray,
                             group_counts: np.ndarray,
                             spread_threshold: float | None = None,
-                            group_masks: np.ndarray | None = None
-                            ) -> np.ndarray:
+                            group_masks: np.ndarray | None = None,
+                            require_available: bool = False) -> np.ndarray:
     """Grouped batch semantics via the sequential loop (mutates state).
 
     Returns per-(group, node) placement counts (G, N) int32; column index N
     (one past the last node) counts infeasible tasks.  This is the function
     the TPU water-fill kernel must match bit-for-bit.
+
+    ``require_available``: feasible-but-unavailable nodes count as column N
+    instead of queueing — the autoscaler's fit-onto-existing-nodes semantics
+    (a demand that doesn't fit now must trigger a launch, not wait).
     """
     thr = threshold_fp(spread_threshold)
     G, N = group_reqs.shape[0], state.num_nodes
@@ -129,7 +135,8 @@ def schedule_grouped_oracle(state: ClusterState, group_reqs: np.ndarray,
     for g in range(G):
         m = group_masks[g] if group_masks is not None else None
         for _ in range(int(group_counts[g])):
-            node = schedule_one(state, group_reqs[g], thr, m)
+            node = schedule_one(state, group_reqs[g], thr, m,
+                                require_available=require_available)
             counts[g, node if node >= 0 else N] += 1
     return counts
 

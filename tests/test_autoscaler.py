@@ -1,0 +1,129 @@
+"""Autoscaler demand packing: oracle semantics + device parity.
+
+Scenario style follows upstream's autoscaler tests (synthetic demand vectors
+against FakeMultiNodeProvider node types — SURVEY.md §4 autoscaler tier;
+scenarios re-derived, not copied)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.autoscaler.demand import (fit_existing, get_nodes_to_launch,
+                                       pack_one_node)
+from ray_tpu.ops.binpack_kernel import autoscale_np
+from ray_tpu.scheduling.oracle import ClusterState
+
+
+def empty_state(n_res=2):
+    z = np.zeros((1, n_res), dtype=np.int32)
+    return ClusterState(z.copy(), z.copy(),
+                        np.zeros(1, dtype=bool))   # no live nodes
+
+
+class TestOracle:
+    def test_fit_existing_first_fit_order(self):
+        st = ClusterState(np.array([[400], [400]], dtype=np.int32),
+                          np.array([[400], [400]], dtype=np.int32))
+        counts, leftover = fit_existing(
+            st, np.array([[100]], dtype=np.int32), np.array([6]))
+        # first-fit: node 0 takes 4, node 1 takes 2 (no spreading)
+        assert counts[0, 0] == 4 and counts[0, 1] == 2
+        assert leftover[0] == 0
+
+    def test_unfit_demand_is_leftover_not_queued(self):
+        st = ClusterState(np.array([[400]], dtype=np.int32),
+                          np.array([[100]], dtype=np.int32))
+        counts, leftover = fit_existing(
+            st, np.array([[200]], dtype=np.int32), np.array([3]))
+        assert counts[0, 0] == 0 and leftover[0] == 3
+
+    def test_pack_one_node_first_fit(self):
+        packed, used = pack_one_node(
+            np.array([800, 400], dtype=np.int32),
+            np.array([[200, 100], [100, 0]], dtype=np.int32),
+            np.array([2, 10]))
+        assert packed.tolist() == [2, 4]          # 2x(200,100) then 4x(100,0)
+        assert used.tolist() == [800, 200]
+
+    def test_launches_cover_leftover(self):
+        st = empty_state(1)
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[100]], dtype=np.int32), np.array([10]),
+            type_caps=np.array([[400]], dtype=np.int32),
+            type_quotas=np.array([5]))
+        assert launches.tolist() == [3] and unmet.sum() == 0
+
+    def test_quota_limits_launches(self):
+        st = empty_state(1)
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[100]], dtype=np.int32), np.array([100]),
+            type_caps=np.array([[400]], dtype=np.int32),
+            type_quotas=np.array([2]))
+        assert launches.tolist() == [2] and unmet[0] == 100 - 8
+
+    def test_prefers_higher_utilization_type(self):
+        st = empty_state(1)
+        # demand 300: type0 cap 400 (util .75) vs type1 cap 1200 (util .25
+        # for 1, but packs 4 => util 1.0) -> type1 wins on score
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[300]], dtype=np.int32), np.array([4]),
+            type_caps=np.array([[400], [1200]], dtype=np.int32),
+            type_quotas=np.array([10, 10]))
+        assert launches.tolist() == [0, 1] and unmet.sum() == 0
+
+    def test_zero_demand_never_launches(self):
+        st = empty_state(1)
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[0]], dtype=np.int32), np.array([50]),
+            type_caps=np.array([[400]], dtype=np.int32),
+            type_quotas=np.array([10]))
+        assert launches.sum() == 0 and unmet.sum() == 0
+
+    def test_infeasible_demand_unmet(self):
+        st = empty_state(1)
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[900]], dtype=np.int32), np.array([2]),
+            type_caps=np.array([[400]], dtype=np.int32),
+            type_quotas=np.array([10]))
+        assert launches.sum() == 0 and unmet[0] == 2
+
+
+def random_autoscale_problem(rng, n_nodes=16, n_res=4, n_groups=10,
+                             n_types=5):
+    totals = rng.integers(0, 2000, size=(n_nodes, n_res)).astype(np.int32)
+    totals[rng.random(totals.shape) < 0.3] = 0
+    avail = (totals * rng.random(totals.shape)).astype(np.int32)
+    mask = rng.random(n_nodes) > 0.2
+    reqs = rng.integers(0, 500, size=(n_groups, n_res)).astype(np.int32)
+    reqs[rng.random(reqs.shape) < 0.5] = 0
+    counts = rng.integers(0, 50, size=n_groups).astype(np.int32)
+    caps = rng.integers(0, 3000, size=(n_types, n_res)).astype(np.int32)
+    caps[rng.random(caps.shape) < 0.2] = 0
+    quotas = rng.integers(0, 8, size=n_types).astype(np.int32)
+    return totals, avail, mask, reqs, counts, caps, quotas
+
+
+class TestDeviceParity:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_bit_exact(self, seed):
+        rng = np.random.default_rng(seed + 100)
+        totals, avail, mask, reqs, counts, caps, quotas = \
+            random_autoscale_problem(rng)
+        launches_d, fit_d, unmet_d, avail_d = autoscale_np(
+            totals, avail, mask, reqs, counts, caps, quotas)
+        st = ClusterState(totals.copy(), avail.copy(), mask.copy())
+        launches_o, fit_o, unmet_o = get_nodes_to_launch(
+            st, reqs, counts, caps, quotas)
+        assert (fit_d == fit_o).all(), seed
+        assert (launches_d == launches_o).all(), seed
+        assert (unmet_d == unmet_o).all(), seed
+        assert (avail_d == st.avail).all(), seed
+
+    def test_million_demand_scale_counts(self):
+        # 1M demands, trivial cluster: batching must keep this instant
+        st = empty_state(1)
+        launches, _, unmet = get_nodes_to_launch(
+            st, np.array([[100]], dtype=np.int32), np.array([1_000_000]),
+            type_caps=np.array([[12800]], dtype=np.int32),
+            type_quotas=np.array([10_000]))
+        assert launches[0] == int(np.ceil(1_000_000 / 128))
+        assert unmet.sum() == 0
