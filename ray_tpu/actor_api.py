@@ -1,0 +1,9 @@
+"""Actor API — placeholder; full actor runtime lands with the actor
+milestone (SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+
+def make_actor_class(cls, options):
+    raise NotImplementedError(
+        "actor support is not wired up yet (next milestone)")

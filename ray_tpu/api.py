@@ -1,0 +1,342 @@
+"""Public API: init/shutdown, @remote, get/put/wait/cancel.
+
+Reference parity: ``python/ray/_private/worker.py`` (init/get/put/wait),
+``python/ray/remote_function.py`` (the ``@ray.remote`` decorator and
+``.remote()``/``.options()``), SURVEY.md §1 layer 9 / §3.1–§3.3; mount
+empty.  One front end serves both the driver process (full runtime) and
+worker processes (``WorkerApiContext`` shim installed by ``worker_main``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+from .common.config import Config, get_config
+from .common.ids import JobID, NodeID, TaskID
+from .common.resources import NodeResources, ResourceRequest, from_cu
+from .common.task_spec import (DEFAULT_STRATEGY, SchedulingStrategy,
+                               TaskSpec, TaskType)
+from .runtime.object_ref import ObjectRef
+from .runtime.object_store import MemoryStore
+from .runtime.raylet import Raylet
+from .runtime.serialization import serialize
+from .scheduling.cluster_resources import ClusterResourceManager
+
+_lock = threading.RLock()
+_runtime: "DriverRuntime | Any | None" = None   # driver or WorkerApiContext
+
+
+def _set_runtime(rt) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def _get_runtime():
+    if _runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _runtime
+
+
+class DriverRuntime:
+    """The in-driver runtime: store + raylet + function registry."""
+
+    is_driver = True
+
+    def __init__(self, resources: dict[str, float], num_workers: int,
+                 job_id: JobID):
+        self.job_id = job_id
+        self.driver_task_id = TaskID.for_task(job_id)
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+        self.store = MemoryStore()
+        self.fn_registry: dict[str, bytes] = {}
+        self.crm = ClusterResourceManager()
+        self.node_id = NodeID.from_random()
+        self.crm.add_node(self.node_id, NodeResources(resources))
+        self.raylet = Raylet(self.node_id, self.crm, self.store,
+                             num_workers, self.fn_registry)
+        self.raylet.start()
+        # block until the pool is at strength: deterministic parallelism
+        # from the first task (reference prestarts workers the same way)
+        self.raylet.pool.wait_ready(num_workers, timeout=60.0)
+
+    # -- API ----------------------------------------------------------------
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        return self.store.get([r.id for r in refs], timeout)
+
+    def put(self, value) -> ObjectRef:
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        from .common.ids import ObjectID
+        oid = ObjectID.for_put(self.driver_task_id, idx)
+        self.store.put(oid, value)
+        return ObjectRef(oid)
+
+    def wait(self, refs, num_returns, timeout):
+        ready_ids, not_ready_ids = self.store.wait(
+            [r.id for r in refs], num_returns, timeout)
+        by_id = {r.id: r for r in refs}
+        return ([by_id[i] for i in ready_ids],
+                [by_id[i] for i in not_ready_ids])
+
+    def submit_spec(self, spec: TaskSpec, fn_id: str,
+                    fn_bytes: bytes | None) -> None:
+        if fn_bytes is not None and fn_id not in self.fn_registry:
+            self.fn_registry[fn_id] = fn_bytes
+        self.raylet.submit(spec)
+
+    def shutdown(self) -> None:
+        self.raylet.stop()
+
+
+# ---------------------------------------------------------------------------
+# RemoteFunction
+# ---------------------------------------------------------------------------
+
+class RemoteFunction:
+    """What ``@ray_tpu.remote`` returns; call ``.remote(*args)``.
+
+    Serializable: shipping one to a worker (e.g. captured in a closure)
+    reconstructs a stub that routes submissions back through that worker's
+    runtime — nested tasks work (reference: workers submit tasks too).
+    """
+
+    def __init__(self, fn: Callable | None, fn_bytes: bytes | None = None,
+                 name: str | None = None, num_returns: int = 1,
+                 resources: dict[str, float] | None = None,
+                 max_retries: int | None = None, fn_id: str | None = None):
+        if fn is None and fn_bytes is None and fn_id is None:
+            raise ValueError("need a function, its bytes, or its id")
+        self._fn = fn
+        self._fn_bytes = fn_bytes
+        self._name = name or getattr(fn, "__qualname__", "anonymous")
+        self._num_returns = num_returns
+        self._resources = dict(resources) if resources else {"CPU": 1}
+        self._max_retries = max_retries
+        # The id is decoration-time random, NOT a content hash: a recursive
+        # remote function's bytes contain its own wrapper, whose pickle
+        # embeds the id — a content hash would be circular (reference keys
+        # its GCS function table the same way: descriptor, not digest).
+        self._fn_id = fn_id or os.urandom(16).hex()
+
+    # -- options ------------------------------------------------------------
+    def options(self, *, num_returns: int | None = None,
+                resources: dict[str, float] | None = None,
+                num_cpus: float | None = None,
+                max_retries: int | None = None) -> "RemoteFunction":
+        res = dict(resources) if resources is not None \
+            else dict(self._resources)
+        if num_cpus is not None:
+            res["CPU"] = num_cpus
+        return RemoteFunction(
+            self._fn, self._fn_bytes, self._name,
+            num_returns if num_returns is not None else self._num_returns,
+            res,
+            max_retries if max_retries is not None else self._max_retries,
+            fn_id=self._fn_id)     # same function => same registry entry
+
+    # -- serialization (registry + shipping) --------------------------------
+    def _materialize(self) -> tuple[str, bytes | None]:
+        if self._fn_bytes is None and self._fn is not None:
+            self._fn_bytes = serialize(self._fn)
+        return self._fn_id, self._fn_bytes
+
+    def __reduce__(self):
+        # Ship as a descriptor stub (id + options), NOT by value: the
+        # function bytes travel separately through the fn registry, and a
+        # stub breaks the self-reference cycle of recursive remote fns.
+        # Driver-side pickling eagerly registers the bytes so a stub that
+        # reaches a worker only as a task argument still resolves; the
+        # reentrancy guard skips this while serializing a recursive fn's
+        # own body (that submission registers it anyway).
+        if not getattr(self, "_reducing", False) and self._fn is not None \
+                and _runtime is not None and getattr(_runtime, "is_driver",
+                                                    False):
+            self._reducing = True
+            try:
+                fn_id, fn_bytes = self._materialize()
+                _runtime.fn_registry.setdefault(fn_id, fn_bytes)
+            finally:
+                self._reducing = False
+        return (RemoteFunction,
+                (None, None, self._name, self._num_returns,
+                 self._resources, self._max_retries, self._fn_id))
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"remote function {self._name} cannot be called directly; "
+            "use .remote()")
+
+    # -- submission ----------------------------------------------------------
+    def remote(self, *args, **kwargs):
+        rt = _get_runtime()
+        fn_id, fn_bytes = self._materialize()
+        retries = self._max_retries if self._max_retries is not None \
+            else get_config().task_max_retries_default
+        if rt.is_driver:
+            job_id = rt.job_id
+            task_id = TaskID.for_task(job_id)
+        else:
+            cur = rt.current_task_id
+            job_id = cur.job_id() if cur else JobID.from_int(0)
+            task_id = TaskID.for_task(job_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=job_id, task_type=TaskType.NORMAL_TASK,
+            function_descriptor=fn_id, args=args, kwargs=kwargs,
+            num_returns=self._num_returns,
+            resources=ResourceRequest(self._resources),
+            strategy=DEFAULT_STRATEGY, max_retries=retries)
+        if rt.is_driver:
+            rt.submit_spec(spec, fn_id, fn_bytes)
+        else:
+            rt.submit_spec(spec, fn_id, fn_bytes)
+        from .common.ids import ObjectID
+        refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
+                for i in range(self._num_returns)]
+        return refs[0] if self._num_returns == 1 else refs
+
+
+def remote(*args, **options):
+    """``@remote`` or ``@remote(num_returns=2, resources={...})``."""
+    if len(args) == 1 and callable(args[0]) and not options:
+        fn = args[0]
+        if isinstance(fn, type):
+            from .actor_api import make_actor_class
+            return make_actor_class(fn, {})
+        return RemoteFunction(fn)
+
+    def wrap(fn):
+        if isinstance(fn, type):
+            from .actor_api import make_actor_class
+            return make_actor_class(fn, options)
+        return RemoteFunction(
+            fn,
+            num_returns=options.get("num_returns", 1),
+            resources=_normalize_resources(options),
+            max_retries=options.get("max_retries"))
+    return wrap
+
+
+def _normalize_resources(options: dict) -> dict[str, float]:
+    res = dict(options.get("resources") or {})
+    if "num_cpus" in options:
+        res["CPU"] = options["num_cpus"]
+    if "num_gpus" in options:
+        res["GPU"] = options["num_gpus"]
+    if "memory" in options:
+        res["memory"] = options["memory"]
+    if "CPU" not in res:
+        res["CPU"] = 1
+    return res
+
+
+# ---------------------------------------------------------------------------
+# module-level API
+# ---------------------------------------------------------------------------
+
+def init(resources: dict[str, float] | None = None,
+         num_workers: int | None = None,
+         system_config: dict | None = None) -> None:
+    global _runtime
+    with _lock:
+        if _runtime is not None:
+            raise RuntimeError("ray_tpu already initialized")
+        if system_config is not None:
+            Config.reset(system_config)
+        cfg = get_config()
+        ncpu = os.cpu_count() or 4
+        if resources is None:
+            resources = {"CPU": ncpu, "memory": 8}
+        if num_workers is None:
+            num_workers = cfg.num_workers_soft_limit or \
+                min(int(resources.get("CPU", ncpu)), ncpu)
+        _runtime = DriverRuntime(resources, num_workers, JobID.next())
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def shutdown() -> None:
+    global _runtime
+    with _lock:
+        if _runtime is not None and getattr(_runtime, "is_driver", False):
+            _runtime.shutdown()
+        _runtime = None
+
+
+def get(refs, timeout: float | None = None):
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get expects ObjectRefs, got {type(r)}")
+    values = _get_runtime().get(ref_list, timeout)
+    return values[0] if single else values
+
+
+def put(value) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put of an ObjectRef is not allowed (reference "
+                        "behavior)")
+    return _get_runtime().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None):
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return _get_runtime().wait(list(refs), num_returns, timeout)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    rt = _get_runtime()
+    if rt.is_driver:
+        rt.raylet.cancel(ref.task_id(), force=force)
+
+
+def available_resources() -> dict[str, float]:
+    rt = _get_runtime()
+    totals, avail, mask = rt.crm.arrays()
+    out: dict[str, float] = {}
+    for row in range(totals.shape[0]):
+        if not mask[row]:
+            continue
+        for col in range(avail.shape[1]):
+            cu = int(avail[row, col])
+            if cu:
+                name = rt.crm.resource_index.name(col)
+                out[name] = out.get(name, 0.0) + from_cu(cu)
+    return out
+
+
+def cluster_resources() -> dict[str, float]:
+    rt = _get_runtime()
+    totals, _, mask = rt.crm.arrays()
+    out: dict[str, float] = {}
+    for row in range(totals.shape[0]):
+        if not mask[row]:
+            continue
+        for col in range(totals.shape[1]):
+            cu = int(totals[row, col])
+            if cu:
+                name = rt.crm.resource_index.name(col)
+                out[name] = out.get(name, 0.0) + from_cu(cu)
+    return out
+
+
+def nodes() -> list[dict]:
+    rt = _get_runtime()
+    out = []
+    totals, _, mask = rt.crm.arrays()
+    for row in range(totals.shape[0]):
+        if mask[row]:
+            nid = rt.crm.id_of(row)
+            out.append({"NodeID": nid.hex() if nid else None,
+                        "Alive": True, "Row": row,
+                        "Labels": rt.crm.labels_of(row)})
+    return out

@@ -46,9 +46,12 @@ import numpy as np
 from ..scheduling.contract import SCALE
 from ..scheduling.oracle import ClusterState, schedule_grouped_oracle
 
-# Any spread threshold above the max score (2*SCALE = 2x utilization) turns
-# the hybrid policy into first-fit-by-traversal-order; 4.0 is comfortably it.
-FIRST_FIT_THRESHOLD = 4.0
+# The smallest spread threshold above the max score (2*SCALE = 2x
+# utilization) turns the hybrid policy into first-fit-by-traversal-order.
+# Exactly 2*SCALE + 1 in fixed point: any higher (e.g. 4*SCALE) pushes
+# (L+1)*totals in the kernel's slot-count inversion past int31 for max-cap
+# nodes (contract.py width audit).
+FIRST_FIT_THRESHOLD = (2 * SCALE + 1) / SCALE
 
 
 @dataclass(frozen=True)

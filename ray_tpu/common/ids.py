@@ -66,6 +66,11 @@ class BaseID:
     def __setattr__(self, *_):
         raise AttributeError(f"{type(self).__name__} is immutable")
 
+    def __reduce__(self):
+        # default slots-state pickling would setattr on load, which the
+        # immutability guard forbids; rebuild through __init__ instead
+        return (type(self), (self._bin,))
+
     def __eq__(self, other):
         return type(other) is type(self) and other._bin == self._bin
 

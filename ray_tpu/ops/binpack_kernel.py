@@ -25,7 +25,9 @@ from ..common.resources import MAX_TOTAL_CU
 from ..scheduling.contract import SCALE
 from .hybrid_kernel import _BIG, schedule_grouped
 
-FIRST_FIT_THR_FP = 4 * SCALE     # > max score 2*SCALE => first-fit traversal
+# Smallest fixed-point threshold above max score => first-fit traversal
+# while keeping (L+1)*totals within int32 (see contract.py width audit).
+FIRST_FIT_THR_FP = 2 * SCALE + 1
 
 
 def _pack_all_types(type_caps, demand_reqs, remaining):

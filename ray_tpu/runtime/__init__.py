@@ -1,0 +1,13 @@
+"""Host runtime: object store, worker pool, raylet, task management.
+
+The control plane of the framework (SURVEY.md §1 layers 4/6/7/9 — raylet,
+object store, core worker, Python API).  Device math lives in ray_tpu/ops;
+everything here is host-side orchestration around it.
+"""
+
+from .object_ref import ObjectRef
+from .object_store import MemoryStore, ObjectLostError, GetTimeoutError
+from .serialization import RayTaskError, WorkerCrashedError
+
+__all__ = ["ObjectRef", "MemoryStore", "ObjectLostError", "GetTimeoutError",
+           "RayTaskError", "WorkerCrashedError"]

@@ -1,0 +1,42 @@
+"""ObjectRef — the future type returned by task submission and put.
+
+Reference parity: ``ray.ObjectRef`` wraps the 28-byte ObjectID plus owner
+metadata (``python/ray/includes/object_ref.pxi`` — SURVEY.md §1 layer 9;
+mount empty).  Resolution goes through ``ray_tpu.get``.
+"""
+
+from __future__ import annotations
+
+from ..common.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id",)
+
+    def __init__(self, object_id: ObjectID):
+        self._id = object_id
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]}…)"

@@ -1,0 +1,135 @@
+"""In-process object store (the core-worker memory store analogue).
+
+Reference parity: every upstream worker embeds an in-process memory store
+for small/in-band objects next to the plasma provider for large ones
+(``src/ray/core_worker/store_provider/memory_store/`` — SURVEY.md §1 layer
+7; mount empty).  This is the driver/worker-side store of the single-node
+slice; the shared-memory arena store (plasma analogue) plugs in behind the
+same interface for large objects.
+
+Semantics carried over: objects are sealed-once immutable; ``get`` blocks
+with timeout; storing a ``RayTaskError`` poisons the object — every get
+raises it (task failure propagation).  Put listeners drive the dependency
+manager (task args become ready) without polling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Sequence
+
+from ..common.ids import ObjectID
+from .serialization import RayError, RayTaskError
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """ray.get timed out (reference: ``ray.exceptions.GetTimeoutError``)."""
+
+
+class ObjectLostError(RayError):
+    """Object was freed/lost and cannot be reconstructed (reference:
+    ``ray.exceptions.ObjectLostError``)."""
+
+
+class MemoryStore:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._objects: dict[ObjectID, object] = {}
+        self._listeners: dict[ObjectID, list[Callable[[ObjectID], None]]] = {}
+
+    # -- write --------------------------------------------------------------
+    def put(self, object_id: ObjectID, value) -> None:
+        with self._cv:
+            if object_id in self._objects:
+                return                      # sealed-once: first write wins
+            self._objects[object_id] = value
+            listeners = self._listeners.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
+    def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        with self._cv:
+            for oid in object_ids:
+                self._objects.pop(oid, None)
+
+    # -- read ---------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            return object_id in self._objects
+
+    def get(self, object_ids: Sequence[ObjectID],
+            timeout: float | None = None) -> list:
+        """Blocking fetch of all ids (in order). Raises stored errors."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                missing = [o for o in object_ids if o not in self._objects]
+                if not missing:
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get timed out; {len(missing)} of "
+                            f"{len(object_ids)} objects not ready")
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+            values = [self._objects[o] for o in object_ids]
+        for v in values:
+            if isinstance(v, RayTaskError):
+                raise v.cause if v.cause is not None else v
+        return values
+
+    def wait(self, object_ids: Sequence[ObjectID], num_returns: int,
+             timeout: float | None = None
+             ) -> tuple[list[ObjectID], list[ObjectID]]:
+        """ray.wait semantics: (ready, not_ready), order-preserving."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in object_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
+            ready_set = set(ready[:num_returns]) if len(ready) > num_returns \
+                else set(ready)
+            ready_list = [o for o in object_ids if o in ready_set]
+            not_ready = [o for o in object_ids if o not in ready_set]
+            return ready_list, not_ready
+
+    def get_raw_blocking(self, object_ids: Sequence[ObjectID]) -> list:
+        """Blocking fetch WITHOUT error unwrap — stored RayTaskError values
+        are returned as values (the worker-side get re-raises them)."""
+        with self._cv:
+            while any(o not in self._objects for o in object_ids):
+                self._cv.wait()
+            return [self._objects[o] for o in object_ids]
+
+    def peek(self, object_id: ObjectID):
+        """Non-blocking raw read (no error unwrap); KeyError if absent."""
+        with self._cv:
+            return self._objects[object_id]
+
+    # -- listeners (dependency manager hook) --------------------------------
+    def on_ready(self, object_id: ObjectID,
+                 callback: Callable[[ObjectID], None]) -> None:
+        """Invoke ``callback(oid)`` once the object exists (immediately if
+        it already does). Callback runs without the store lock held."""
+        with self._cv:
+            if object_id not in self._objects:
+                self._listeners.setdefault(object_id, []).append(callback)
+                return
+        callback(object_id)
+
+    def size(self) -> int:
+        with self._cv:
+            return len(self._objects)

@@ -1,0 +1,324 @@
+"""Raylet: per-node task queueing, scheduling, dispatch, and completion.
+
+Reference parity: the raylet's ``NodeManager`` + ``ClusterTaskManager``
+(queue by scheduling class, schedule per event-loop turn) +
+``LocalTaskManager`` (resource allocation + worker handout) +
+``DependencyManager`` (hold tasks until args exist) — ``src/ray/raylet/``,
+SURVEY.md §1 layer 4 / §3.2 hot loop; mount empty.
+
+Single-process form: one Raylet owns the local ``ClusterResourceManager``
+row, a ``WorkerPool`` of spawned processes, and the in-process object
+store.  The scheduling loop is an event-driven thread (condition variable,
+not a busy tick): it wakes on task arrival, dependency readiness, worker
+release, and resource release — the same wake set as the reference's asio
+event loop.  The simulated multi-node harness instantiates N of these over
+one shared resource view.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..common.ids import TaskID
+from ..common.resources import ResourceRequest
+from ..common.task_spec import TaskSpec
+from ..scheduling.cluster_resources import ClusterResourceManager
+from .object_ref import ObjectRef
+from .object_store import MemoryStore
+from .serialization import (RayTaskError, WorkerCrashedError, deserialize,
+                            serialize)
+from .task_manager import TaskManager
+from .worker_pool import WorkerHandle, WorkerPool
+
+
+class Raylet:
+    def __init__(self, node_id, crm: ClusterResourceManager,
+                 store: MemoryStore, num_workers: int,
+                 fn_registry: dict[str, bytes]):
+        self.node_id = node_id
+        self.crm = crm
+        self.row = crm.row_of(node_id)
+        self.store = store
+        self.task_manager = TaskManager()
+        self._fn_registry = fn_registry
+        self._cv = threading.Condition()
+        self._queue: deque[TaskID] = deque()
+        self._waiting: dict[TaskID, int] = {}   # task -> missing dep count
+        self._running: dict[bytes, tuple[TaskID, WorkerHandle]] = {}
+        self._stopped = False
+        self._dirty = False     # wake flag: new task / capacity / worker
+        self.pool = WorkerPool(num_workers, self._on_worker_message,
+                               self._on_worker_death,
+                               on_idle=self._notify_dirty)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"raylet-{self.row}")
+
+    def start(self) -> None:
+        self.pool.start()
+        self._thread.start()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> list[ObjectRef]:
+        rec = self.task_manager.register(spec)
+        deps = [a.id for a in spec.args if isinstance(a, ObjectRef)]
+        missing = [d for d in deps if not self.store.contains(d)]
+        if missing:
+            with self._cv:
+                self._waiting[spec.task_id] = len(missing)
+            for d in missing:
+                self.store.on_ready(d, lambda _oid, t=spec.task_id:
+                                    self._dep_ready(t))
+        else:
+            self._enqueue(spec.task_id)
+        return [ObjectRef(oid) for oid in rec.return_ids]
+
+    def _dep_ready(self, task_id: TaskID) -> None:
+        with self._cv:
+            left = self._waiting.get(task_id)
+            if left is None:
+                return
+            if left <= 1:
+                del self._waiting[task_id]
+                self._queue.append(task_id)
+                self._cv.notify_all()
+            else:
+                self._waiting[task_id] = left - 1
+
+    def _enqueue(self, task_id: TaskID) -> None:
+        with self._cv:
+            self._queue.append(task_id)
+            self._dirty = True
+            self._cv.notify_all()
+
+    def _notify_dirty(self) -> None:
+        with self._cv:
+            self._dirty = True
+            self._cv.notify_all()
+
+    # -- scheduling loop ----------------------------------------------------
+    def _loop(self) -> None:
+        """Event-driven: wakes only when the dirty flag was raised (task
+        arrival, dep readiness, worker idle, resources freed) — a leftover
+        queue alone does NOT re-trigger, so an unplaceable backlog parks
+        instead of busy-spinning."""
+        while True:
+            with self._cv:
+                while not self._stopped and not (self._dirty and self._queue):
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                self._dirty = False
+                batch = list(self._queue)
+                self._queue.clear()
+            leftover = self._dispatch_batch(batch)
+            if leftover:
+                with self._cv:
+                    # keep arrival order: leftovers go back to the front
+                    self._queue.extendleft(reversed(leftover))
+
+    def _dispatch_batch(self, batch: list[TaskID]) -> list[TaskID]:
+        leftover: list[TaskID] = []
+        for i, task_id in enumerate(batch):
+            rec = self.task_manager.get(task_id)
+            if rec is None or rec.done:
+                continue
+            spec = rec.spec
+            # reserve resources BEFORE popping a worker: pool.release fires
+            # the idle wake-up, so a speculative pop-then-release of the
+            # same worker would spin the loop on an unplaceable backlog
+            if not self.crm.subtract(self.row, spec.resources):
+                leftover.append(task_id)
+                continue
+            worker = self.pool.pop_idle()
+            if worker is None:
+                self.crm.add_back(self.row, spec.resources)
+                leftover.append(task_id)
+                leftover.extend(batch[i + 1:])
+                break
+            if not self._dispatch(worker, rec):
+                # dep error or send failure; resources already returned
+                continue
+        return leftover
+
+    def _dispatch(self, worker: WorkerHandle, rec) -> bool:
+        spec = rec.spec
+        # resolve top-level ObjectRef args (deps are ready by construction)
+        args = []
+        dep_error = None
+        for a in spec.args:
+            if isinstance(a, ObjectRef):
+                v = self.store.peek(a.id)
+                if isinstance(v, RayTaskError):
+                    dep_error = v
+                    break
+                args.append(v)
+            else:
+                args.append(a)
+        if dep_error is not None:
+            # propagate the dependency's error to this task's outputs
+            # without executing (reference: failed deps fail the task)
+            self._finish_with_error(rec, dep_error, worker)
+            return False
+
+        fn_id = spec.function_descriptor
+        if fn_id not in worker.fn_cache:
+            if not worker.send(("fn", fn_id, self._fn_registry[fn_id])):
+                self._requeue_after_worker_loss(rec, worker)
+                return False
+            worker.fn_cache.add(fn_id)
+        payload = serialize((tuple(args), spec.kwargs, spec.num_returns))
+        worker.leased_task = spec.task_id.binary()
+        with self._cv:
+            self._running[spec.task_id.binary()] = (spec.task_id, worker)
+        if not worker.send(("exec", spec.task_id.binary(), fn_id, payload)):
+            with self._cv:
+                self._running.pop(spec.task_id.binary(), None)
+            self._requeue_after_worker_loss(rec, worker)
+            return False
+        return True
+
+    def _requeue_after_worker_loss(self, rec, worker: WorkerHandle) -> None:
+        self.crm.add_back(self.row, rec.spec.resources)
+        worker.dead = True
+        self._enqueue(rec.spec.task_id)
+
+    def _finish_with_error(self, rec, error: RayTaskError,
+                           worker: WorkerHandle | None) -> None:
+        self.task_manager.complete(rec.spec.task_id)
+        for oid in rec.return_ids:
+            self.store.put(oid, error)
+        self.crm.add_back(self.row, rec.spec.resources)
+        if worker is not None:
+            self.pool.release(worker)
+        self._notify_dirty()
+
+    # -- worker frame handling (runs on reader threads) ---------------------
+    def _on_worker_message(self, worker: WorkerHandle, msg: tuple) -> None:
+        kind = msg[0]
+        if kind in ("result", "error"):
+            task_id_bin = msg[1]
+            with self._cv:
+                entry = self._running.pop(task_id_bin, None)
+            if entry is None:
+                self.pool.release(worker)
+                return
+            task_id, _ = entry
+            rec = self.task_manager.complete(task_id)
+            if rec is not None:
+                if kind == "result":
+                    for oid, data in zip(rec.return_ids, msg[2]):
+                        self.store.put(oid, deserialize(data))
+                else:
+                    err = deserialize(msg[2])
+                    for oid in rec.return_ids:
+                        self.store.put(oid, err)
+                self.crm.add_back(self.row, rec.spec.resources)
+            self.pool.release(worker)
+            self._notify_dirty()
+        elif kind == "get":
+            oids = [self._oid(b) for b in msg[1]]
+            if all(self.store.contains(o) for o in oids):
+                worker.send(("get_reply",
+                             serialize(self.store.get_raw_blocking(oids))))
+                return
+            # Blocking get: release the task's resources while the worker
+            # waits (reference: CPU is returned during ray.get so dependent
+            # tasks can run) and grow the pool if it is starved — otherwise
+            # recursive fan-out deadlocks on worker slots.
+            rec = None
+            if worker.leased_task is not None:
+                with self._cv:
+                    entry = self._running.get(worker.leased_task)
+                if entry is not None:
+                    rec = self.task_manager.get(entry[0])
+            worker.blocked = True
+            if rec is not None:
+                self.crm.add_back(self.row, rec.spec.resources)
+                self._notify_dirty()
+            self.pool.grow_for_blocked()
+            values = self.store.get_raw_blocking(oids)
+            # re-acquire before resuming (waits for capacity like the
+            # reference's worker unblock path; bounded oversubscription is
+            # preferred over a stuck reader if capacity never frees)
+            if rec is not None:
+                self._reacquire(rec.spec.resources)
+            worker.blocked = False
+            worker.send(("get_reply", serialize(values)))
+        elif kind == "put":
+            self.store.put(self._oid(msg[1]), deserialize(msg[2]))
+        elif kind == "submit":
+            spec = deserialize(msg[1])
+            fn_id, fn_bytes = msg[2], msg[3]
+            if fn_bytes is not None and fn_id not in self._fn_registry:
+                self._fn_registry[fn_id] = fn_bytes
+            self.submit(spec)
+
+    @staticmethod
+    def _oid(binary: bytes):
+        from ..common.ids import ObjectID
+        return ObjectID(binary)
+
+    def _reacquire(self, resources: ResourceRequest,
+                   patience: float = 5.0) -> None:
+        import time
+        deadline = time.monotonic() + patience
+        while not self.crm.subtract(self.row, resources):
+            if time.monotonic() >= deadline:
+                # oversubscribe rather than wedge: force the debit so the
+                # books stay balanced when the task completes
+                self.crm.force_subtract(self.row, resources)
+                return
+            time.sleep(0.002)
+
+    def _on_worker_death(self, worker: WorkerHandle) -> None:
+        task_id_bin = worker.leased_task
+        if task_id_bin is None:
+            return
+        with self._cv:
+            entry = self._running.pop(task_id_bin, None)
+        if entry is None:
+            return
+        task_id, _ = entry
+        rec = self.task_manager.get(task_id)
+        if rec is None:
+            return
+        self.crm.add_back(self.row, rec.spec.resources)
+        if self.task_manager.should_retry(task_id):
+            self._enqueue(task_id)
+        else:
+            self.task_manager.complete(task_id)
+            err = RayTaskError(
+                rec.spec.function_descriptor,
+                "worker died", WorkerCrashedError(
+                    f"worker {worker.index} died executing "
+                    f"{rec.spec.function_descriptor}"))
+            for oid in rec.return_ids:
+                self.store.put(oid, err)
+        self._notify_dirty()
+
+    # -- cancel / teardown --------------------------------------------------
+    def cancel(self, task_id: TaskID, force: bool = False) -> bool:
+        from .serialization import TaskCancelledError
+        with self._cv:
+            if task_id in self._queue:
+                self._queue.remove(task_id)
+                rec = self.task_manager.complete(task_id)
+                if rec:
+                    err = RayTaskError(rec.spec.function_descriptor,
+                                       "cancelled", TaskCancelledError())
+                    for oid in rec.return_ids:
+                        self.store.put(oid, err)
+                return True
+            entry = self._running.get(task_id.binary())
+        if entry is not None and force:
+            _, worker = entry
+            self.pool.kill_worker(worker)   # death path handles bookkeeping
+            return True
+        return False
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self.pool.shutdown()

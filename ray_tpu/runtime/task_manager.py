@@ -1,0 +1,71 @@
+"""Owner-side task bookkeeping: lifetimes, retries, completion.
+
+Reference parity: the core worker's ``TaskManager`` (retry budget and
+completion accounting for submitted tasks) — ``src/ray/core_worker/
+task_manager.cc``, SURVEY.md §1 layer 7; mount empty.  Lineage pinning for
+reconstruction builds on the ``specs`` this manager retains.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..common.ids import ObjectID, TaskID
+from ..common.task_spec import TaskSpec
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    retries_left: int
+    return_ids: list[ObjectID]
+    done: bool = False
+
+
+class TaskManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: dict[TaskID, TaskRecord] = {}
+
+    def register(self, spec: TaskSpec) -> TaskRecord:
+        return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
+                      for i in range(spec.num_returns)]
+        rec = TaskRecord(spec, spec.max_retries, return_ids)
+        with self._lock:
+            self._records[spec.task_id] = rec
+        return rec
+
+    def get(self, task_id: TaskID) -> TaskRecord | None:
+        with self._lock:
+            return self._records.get(task_id)
+
+    def complete(self, task_id: TaskID) -> TaskRecord | None:
+        with self._lock:
+            rec = self._records.get(task_id)
+            if rec is not None:
+                rec.done = True
+            return rec
+
+    def should_retry(self, task_id: TaskID) -> bool:
+        """Consume one retry if any remain (worker-crash path)."""
+        with self._lock:
+            rec = self._records.get(task_id)
+            if rec is None or rec.done or rec.retries_left <= 0:
+                return False
+            rec.retries_left -= 1
+            rec.spec.attempt_number += 1
+            return True
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(not r.done for r in self._records.values())
+
+    def pop_finished(self, keep_lineage: bool = True) -> None:
+        """Drop completed records (lineage pinning keeps them by default
+        until the reconstruction budget evicts — SURVEY §5.3/§5.4)."""
+        if keep_lineage:
+            return
+        with self._lock:
+            for tid in [t for t, r in self._records.items() if r.done]:
+                del self._records[tid]

@@ -1,0 +1,140 @@
+"""Worker process: executes tasks shipped by the raylet.
+
+Reference parity: the worker side of upstream's core worker —
+``CoreWorker::ExecuteTask`` receiving ``PushTask`` RPCs, with an in-worker
+API surface so user functions can call ``get/put/wait/.remote`` from inside
+a task (``src/ray/core_worker/``, SURVEY.md §3.2 tail; mount empty).
+
+Transport: one duplex ``multiprocessing`` connection to the owning raylet.
+The worker is single-threaded and synchronous: while it executes a task the
+only frames it can receive are replies to its own requests, so plain
+send/recv pairs are race-free without correlation ids.
+
+Frames (tuples, first element is the kind):
+  raylet -> worker: ("fn", fn_id, bytes), ("exec", task_id_bin, fn_id,
+                    payload), ("get_reply", payload), ("shutdown",)
+  worker -> raylet: ("ready",), ("result", task_id_bin, [bytes, ...]),
+                    ("error", task_id_bin, bytes), ("get", [oid_bin, ...]),
+                    ("put", oid_bin, bytes), ("submit", spec_bytes,
+                    fn_id, fn_bytes | None)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from ..common.ids import ObjectID, TaskID
+from .object_ref import ObjectRef
+from .serialization import RayTaskError, deserialize, serialize
+
+
+class WorkerApiContext:
+    """The in-worker implementation of the public API (get/put/submit).
+
+    Installed as the process-global runtime by ``worker_main``; the
+    ``ray_tpu.api`` front end routes to it when running inside a worker.
+    """
+
+    is_driver = False
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._task_id: TaskID | None = None
+        self._put_index = 0
+
+    # -- task lifecycle (called by the exec loop) ---------------------------
+    def begin_task(self, task_id: TaskID):
+        self._task_id = task_id
+        self._put_index = 0
+
+    def end_task(self):
+        self._task_id = None
+
+    @property
+    def current_task_id(self) -> TaskID | None:
+        return self._task_id
+
+    # -- API ----------------------------------------------------------------
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        self._conn.send(("get", [r.binary() for r in refs]))
+        kind, payload = self._conn.recv()
+        assert kind == "get_reply", kind
+        values = deserialize(payload)
+        for v in values:
+            if isinstance(v, RayTaskError):
+                raise v.cause if v.cause is not None else v
+        return values
+
+    def put(self, value) -> ObjectRef:
+        assert self._task_id is not None, "put outside a task"
+        self._put_index += 1
+        oid = ObjectID.for_put(self._task_id, self._put_index)
+        self._conn.send(("put", oid.binary(), serialize(value)))
+        return ObjectRef(oid)
+
+    def wait(self, refs, num_returns, timeout):
+        # worker-side wait degrades to a full get of the first num_returns
+        # (v1: no partial-wait RPC; the raylet-side store answers gets)
+        ready = refs[:num_returns]
+        self.get(ready, timeout)
+        return ready, refs[num_returns:]
+
+    def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
+        self._conn.send(("submit", serialize(spec), fn_id, fn_bytes))
+
+
+def worker_main(conn, worker_index: int) -> None:
+    """Entry point of a spawned worker process."""
+    # workers never own the TPU: the device data plane belongs to the
+    # raylet/driver process; user task code that imports jax gets CPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from .. import api
+
+    ctx = WorkerApiContext(conn)
+    api._set_runtime(ctx)
+    fn_table: dict[str, object] = {}
+    conn.send(("ready",))
+
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        kind = msg[0]
+        if kind == "fn":
+            fn_table[msg[1]] = deserialize(msg[2])
+        elif kind == "exec":
+            _, task_id_bin, fn_id, payload = msg
+            args, kwargs, num_returns = deserialize(payload)
+            fn = fn_table[fn_id]
+            name = getattr(fn, "__qualname__", str(fn))
+            ctx.begin_task(TaskID(task_id_bin))
+            try:
+                out = fn(*args, **kwargs)
+                if num_returns == 1:
+                    results = [out]
+                elif num_returns == 0:
+                    results = []
+                else:
+                    results = list(out)
+                    if len(results) != num_returns:
+                        raise ValueError(
+                            f"task {name} declared num_returns="
+                            f"{num_returns} but returned {len(results)} "
+                            "values")
+                conn.send(("result", task_id_bin,
+                           [serialize(r) for r in results]))
+            except BaseException as e:  # noqa: BLE001 — any task failure
+                err = RayTaskError.from_exception(name, e)
+                try:
+                    conn.send(("error", task_id_bin, serialize(err)))
+                except Exception:
+                    conn.send(("error", task_id_bin, serialize(
+                        RayTaskError(name, err.tb, None))))
+            finally:
+                ctx.end_task()
+        elif kind == "shutdown":
+            break
+    sys.exit(0)

@@ -1,0 +1,196 @@
+"""Worker pool: spawns, leases, and monitors worker processes.
+
+Reference parity: the raylet's ``WorkerPool`` (prestarted per-language
+workers, ``PopWorker``/``PushWorker`` lease handout, crash detection via
+socket disconnect — ``src/ray/raylet/worker_pool.cc``, SURVEY.md §1 layer 4;
+mount empty).
+
+Workers are spawned (not forked): the driver owns a live TPU/JAX runtime
+whose threads and device handles must not leak into children; spawn also
+lets us scrub the axon/TPU env so workers never contend for the chip.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+from typing import Callable
+
+from .worker import worker_main
+
+# env vars that would make a spawned worker grab or re-register the TPU
+_SCRUB_ENV = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY")
+_spawn_env_lock = threading.Lock()
+
+
+class WorkerHandle:
+    def __init__(self, index: int, proc, conn):
+        self.index = index
+        self.proc = proc
+        self.conn = conn
+        self.send_lock = threading.Lock()   # scheduler + reader both send
+        self.ready = False
+        self.dead = False
+        self.blocked = False                # inside a blocking get
+        self.leased_task = None             # task_id_bin while executing
+        self.fn_cache: set[str] = set()
+
+    def send(self, msg) -> bool:
+        with self.send_lock:
+            if self.dead:
+                return False
+            try:
+                self.conn.send(msg)
+                return True
+            except (OSError, BrokenPipeError):
+                self.dead = True
+                return False
+
+
+class WorkerPool:
+    """Owns worker processes; routes their frames to the raylet."""
+
+    def __init__(self, num_workers: int,
+                 on_message: Callable[[WorkerHandle, tuple], None],
+                 on_death: Callable[[WorkerHandle], None],
+                 on_idle: Callable[[], None] | None = None):
+        self._num = num_workers
+        self._on_message = on_message
+        self._on_death = on_death
+        self._on_idle = on_idle or (lambda: None)
+        self._ctx = mp.get_context("spawn")
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._workers: list[WorkerHandle] = []
+        self._idle: list[WorkerHandle] = []
+        self._next_index = 0
+        self._shutdown = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        for _ in range(self._num):
+            self._spawn_one()
+
+    def _spawn_one(self) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            index = self._next_index
+            self._next_index += 1
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        with _spawn_env_lock:
+            saved = {k: os.environ.pop(k) for k in _SCRUB_ENV
+                     if k in os.environ}
+            try:
+                proc = self._ctx.Process(
+                    target=worker_main, args=(child_conn, index),
+                    daemon=True, name=f"rt-worker-{index}")
+                proc.start()
+            finally:
+                os.environ.update(saved)
+        child_conn.close()
+        handle = WorkerHandle(index, proc, parent_conn)
+        with self._lock:
+            self._workers.append(handle)
+        threading.Thread(target=self._reader, args=(handle,),
+                         daemon=True, name=f"rt-reader-{index}").start()
+
+    def _reader(self, handle: WorkerHandle) -> None:
+        while True:
+            try:
+                msg = handle.conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] == "ready":
+                with self._cv:
+                    handle.ready = True
+                    self._idle.append(handle)
+                    self._cv.notify_all()
+                self._on_idle()
+                continue
+            try:
+                self._on_message(handle, msg)
+            except Exception:  # noqa: BLE001 — a bad frame must not kill
+                import traceback
+                traceback.print_exc()
+        handle.dead = True
+        with self._cv:
+            if handle in self._idle:
+                self._idle.remove(handle)
+            self._cv.notify_all()
+        if not self._shutdown:
+            self._on_death(handle)
+            self._spawn_one()               # keep the pool at strength
+
+    # -- leasing ------------------------------------------------------------
+    def pop_idle(self) -> WorkerHandle | None:
+        with self._cv:
+            while self._idle:
+                h = self._idle.pop()
+                if not h.dead:
+                    return h
+            return None
+
+    def release(self, handle: WorkerHandle) -> None:
+        with self._cv:
+            handle.leased_task = None
+            if not handle.dead and handle not in self._idle:
+                self._idle.append(handle)
+                self._cv.notify_all()
+        self._on_idle()
+
+    def wait_ready(self, count: int = 1, timeout: float = 60.0) -> bool:
+        """Block until at least ``count`` workers signalled ready."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while sum(h.ready and not h.dead for h in self._workers) < count:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def num_alive(self) -> int:
+        with self._lock:
+            return sum(not h.dead for h in self._workers)
+
+    def grow_for_blocked(self, max_factor: int = 4) -> bool:
+        """Spawn one extra worker when the pool is starved by workers
+        parked in a blocking get (reference: workers blocked in ray.get
+        stop counting toward the soft limit, and the pool starts
+        replacements on demand — SURVEY §3.2 lease notes)."""
+        with self._lock:
+            alive = [h for h in self._workers if not h.dead]
+            unblocked = sum(not h.blocked for h in alive)
+            if self._idle or unblocked >= self._num \
+                    or len(alive) >= self._num * max_factor:
+                return False
+        self._spawn_one()
+        return True
+
+    def kill_worker(self, handle: WorkerHandle) -> None:
+        """Force-kill (ray.cancel(force=True) / ray.kill path)."""
+        handle.dead = True
+        try:
+            handle.proc.terminate()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            workers = list(self._workers)
+        for h in workers:
+            h.send(("shutdown",))
+        for h in workers:
+            h.proc.join(timeout=2.0)
+            if h.proc.is_alive():
+                h.proc.terminate()
+        for h in workers:
+            try:
+                h.conn.close()
+            except Exception:
+                pass

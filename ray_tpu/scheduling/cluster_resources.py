@@ -138,6 +138,13 @@ class ClusterResourceManager:
             self.version += 1
             return True
 
+    def force_subtract(self, row: int, req: ResourceRequest) -> None:
+        """Debit even into negative availability (bounded oversubscription
+        on worker-unblock; the matching add_back rebalances)."""
+        with self._lock:
+            self.avail[row] -= self._dense_req(req)
+            self.version += 1
+
     def add_back(self, row: int, req: ResourceRequest) -> None:
         with self._lock:
             vec = self._dense_req(req)

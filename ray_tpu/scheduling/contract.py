@@ -43,7 +43,9 @@ Width audit (why int32 suffices end to end, incl. on TPU):
     T, A, r      <= MAX_TOTAL_CU = 2**17
     q = used + r <= 2 * 2**17 = 2**18
     q * SCALE    <= 2**30 < 2**31 - 1          (the score numerator)
-    (L+1) * T    <= (2*SCALE + 1) * 2**17 < 2**30   (water-fill inversion)
+    (L+1) * T    <= (2*SCALE + 1) * 2**17 < 2**31   (water-fill inversion;
+                    L is capped by the largest permitted threshold
+                    2*SCALE + 1 = the autoscaler first-fit threshold)
     key          <  2**28
 """
 

@@ -47,6 +47,26 @@ class ClusterState:
         return self.totals.shape[0]
 
 
+def _schedule_one_info(state: ClusterState, req: np.ndarray,
+                       thr_fp: int, extra_mask: np.ndarray | None,
+                       commit: bool, require_available: bool
+                       ) -> tuple[int, bool]:
+    """(node, consumed): core of schedule_one; consumed=False means the
+    placement did not change state (queued or infeasible) — a fixed point
+    for identical follow-up requests."""
+    mask = state.node_mask if extra_mask is None \
+        else (state.node_mask & extra_mask)
+    keys = compute_keys(state.totals, state.avail, req, thr_fp, mask)
+    node = int(np.argmin(keys))
+    if keys[node] == INFEASIBLE_KEY:
+        return -1, False
+    if (keys[node] >> AVAIL_SHIFT) != 0:             # best is unavailable
+        return (-1, False) if require_available else (node, False)
+    if commit:
+        state.avail[node] -= np.asarray(req, dtype=np.int32)
+    return node, commit and bool((np.asarray(req) > 0).any())
+
+
 def schedule_one(state: ClusterState, req: np.ndarray,
                  thr_fp: int, extra_mask: np.ndarray | None = None,
                  commit: bool = True, require_available: bool = False) -> int:
@@ -57,17 +77,8 @@ def schedule_one(state: ClusterState, req: np.ndarray,
     (contract; reference behavior per SURVEY §2.5 item 4), unless
     ``require_available``, in which case they return -1.
     """
-    mask = state.node_mask if extra_mask is None \
-        else (state.node_mask & extra_mask)
-    keys = compute_keys(state.totals, state.avail, req, thr_fp, mask)
-    node = int(np.argmin(keys))
-    if keys[node] == INFEASIBLE_KEY:
-        return -1
-    if (keys[node] >> AVAIL_SHIFT) != 0:             # best is unavailable
-        return -1 if require_available else node
-    if commit:
-        state.avail[node] -= np.asarray(req, dtype=np.int32)
-    return node
+    return _schedule_one_info(state, req, thr_fp, extra_mask, commit,
+                              require_available)[0]
 
 
 def schedule_tasks(state: ClusterState, reqs: np.ndarray,
@@ -134,10 +145,19 @@ def schedule_grouped_oracle(state: ClusterState, group_reqs: np.ndarray,
     counts = np.zeros((G, N + 1), dtype=np.int32)
     for g in range(G):
         m = group_masks[g] if group_masks is not None else None
-        for _ in range(int(group_counts[g])):
-            node = schedule_one(state, group_reqs[g], thr, m,
-                                require_available=require_available)
-            counts[g, node if node >= 0 else N] += 1
+        remaining = int(group_counts[g])
+        while remaining > 0:
+            node, consumed = _schedule_one_info(
+                state, group_reqs[g], thr, m, True, require_available)
+            if consumed:
+                counts[g, node] += 1
+                remaining -= 1
+                continue
+            # fixed point: state unchanged => every remaining request of
+            # this class lands identically (empty request, queue on the
+            # same feasible node, or infeasible) — bit-exact short-cut
+            counts[g, node if node >= 0 else N] += remaining
+            break
     return counts
 
 
