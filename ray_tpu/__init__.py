@@ -18,7 +18,7 @@ from .common import (Config, NodeResources, ResourceRequest, get_config)
 # The runtime API (init/remote/get/put/...) is imported lazily to keep
 # `import ray_tpu` light for scheduler-only users (e.g. the bench harness).
 _API_NAMES = ("init", "shutdown", "is_initialized", "remote", "get", "put",
-              "wait", "cancel", "kill", "method", "get_runtime_context",
+              "wait", "cancel", "kill", "get_actor",
               "available_resources", "cluster_resources", "nodes")
 
 

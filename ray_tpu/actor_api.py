@@ -1,9 +1,141 @@
-"""Actor API — placeholder; full actor runtime lands with the actor
-milestone (SURVEY.md §3.4)."""
+"""Actor API: @remote classes, handles, ordered method calls.
+
+Reference parity: ``python/ray/actor.py`` — ``ActorClass`` (from decorating
+a class), ``ActorHandle`` with dynamic method accessors, ``.options(...)``
+(name, max_restarts, max_task_retries), named-actor lookup
+(``ray.get_actor``), graceful ``__ray_terminate__`` — SURVEY.md §3.4;
+mount empty.  The lifecycle/ordering machinery lives in
+``runtime/actor_manager.py``.
+"""
 
 from __future__ import annotations
 
+import os
+from typing import Any
 
-def make_actor_class(cls, options):
-    raise NotImplementedError(
-        "actor support is not wired up yet (next milestone)")
+from .common.ids import ActorID, JobID, ObjectID, TaskID
+from .runtime.object_ref import ObjectRef
+from .runtime.serialization import serialize
+
+
+def _runtime():
+    from . import api
+    return api._get_runtime()
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns: int | None = None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           num_returns if num_returns is not None
+                           else self._num_returns)
+
+    def remote(self, *args, **kwargs):
+        rt = _runtime()
+        actor_id = self._handle._actor_id
+        job_id = actor_id.job_id()
+        task_id = TaskID.for_task(job_id, actor_id)
+        if rt.is_driver:
+            rt.actor_manager.submit(actor_id, task_id, self._name, args,
+                                    kwargs, self._num_returns)
+        else:
+            rt.submit_actor_call(actor_id, task_id, self._name, args,
+                                 kwargs, self._num_returns)
+        refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
+                for i in range(self._num_returns)]
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"actor method {self._name} cannot be called directly; "
+            "use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]}…)"
+
+    def __ray_terminate__(self):
+        """Graceful stop: queued behind pending method calls."""
+        return ActorMethod(self, "__ray_terminate__").remote()
+
+
+class ActorClass:
+    def __init__(self, cls: type | None, cls_bytes: bytes | None = None,
+                 name: str | None = None, cls_id: str | None = None,
+                 options: dict[str, Any] | None = None):
+        self._cls = cls
+        self._cls_bytes = cls_bytes
+        self._cls_name = name or getattr(cls, "__name__", "Actor")
+        self._cls_id = cls_id or os.urandom(16).hex()
+        self._options = dict(options or {})
+
+    def options(self, **options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(options)
+        return ActorClass(self._cls, self._cls_bytes, self._cls_name,
+                          self._cls_id, merged)
+
+    def _materialize(self) -> tuple[str, bytes | None]:
+        if self._cls_bytes is None and self._cls is not None:
+            self._cls_bytes = serialize(self._cls)
+        return self._cls_id, self._cls_bytes
+
+    def __reduce__(self):
+        # descriptor stub, mirroring RemoteFunction.__reduce__
+        from . import api
+        if self._cls is not None and api._runtime is not None and \
+                getattr(api._runtime, "is_driver", False):
+            cls_id, cls_bytes = self._materialize()
+            api._runtime.fn_registry.setdefault(cls_id, cls_bytes)
+        return (ActorClass, (None, None, self._cls_name, self._cls_id,
+                             self._options))
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"actor class {self._cls_name} cannot be instantiated "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from .common.config import get_config
+        rt = _runtime()
+        opts = self._options
+        max_restarts = opts.get(
+            "max_restarts", get_config().actor_max_restarts_default)
+        max_task_retries = opts.get("max_task_retries", 0)
+        name = opts.get("name")
+        cls_id, cls_bytes = self._materialize()
+        if rt.is_driver:
+            actor_id = ActorID.of(rt.job_id)
+            rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
+                            max_restarts, max_task_retries, name)
+        else:
+            cur = rt.current_task_id
+            job_id = cur.job_id() if cur else JobID.from_int(0)
+            actor_id = ActorID.of(job_id)
+            rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
+                            max_restarts, max_task_retries, name)
+        return ActorHandle(actor_id)
+
+
+def make_actor_class(cls: type, options: dict[str, Any]) -> ActorClass:
+    opts = dict(options)
+    if "max_restarts" in opts and opts["max_restarts"] == -1:
+        opts["max_restarts"] = -1           # infinite restarts
+    return ActorClass(cls, options=opts)

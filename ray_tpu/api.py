@@ -57,6 +57,9 @@ class DriverRuntime:
         self.crm.add_node(self.node_id, NodeResources(resources))
         self.raylet = Raylet(self.node_id, self.crm, self.store,
                              num_workers, self.fn_registry)
+        from .runtime.actor_manager import ActorManager
+        self.actor_manager = ActorManager(self.raylet, self.fn_registry)
+        self.raylet.actor_manager = self.actor_manager
         self.raylet.start()
         # block until the pool is at strength: deterministic parallelism
         # from the first task (reference prestarts workers the same way)
@@ -87,6 +90,12 @@ class DriverRuntime:
         if fn_bytes is not None and fn_id not in self.fn_registry:
             self.fn_registry[fn_id] = fn_bytes
         self.raylet.submit(spec)
+
+    def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
+                     max_restarts, max_task_retries, name) -> None:
+        self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
+                                        kwargs, max_restarts,
+                                        max_task_retries, name)
 
     def shutdown(self) -> None:
         self.raylet.stop()
@@ -189,10 +198,7 @@ class RemoteFunction:
             num_returns=self._num_returns,
             resources=ResourceRequest(self._resources),
             strategy=DEFAULT_STRATEGY, max_retries=retries)
-        if rt.is_driver:
-            rt.submit_spec(spec, fn_id, fn_bytes)
-        else:
-            rt.submit_spec(spec, fn_id, fn_bytes)
+        rt.submit_spec(spec, fn_id, fn_bytes)
         from .common.ids import ObjectID
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
                 for i in range(self._num_returns)]
@@ -297,6 +303,33 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     rt = _get_runtime()
     if rt.is_driver:
         rt.raylet.cancel(ref.task_id(), force=force)
+
+
+def kill(actor_handle, *, no_restart: bool = True) -> None:
+    """Forcefully terminate an actor (reference: ``ray.kill``)."""
+    from .actor_api import ActorHandle
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("ray_tpu.kill expects an ActorHandle")
+    rt = _get_runtime()
+    if rt.is_driver:
+        rt.actor_manager.kill(actor_handle._actor_id, no_restart=no_restart)
+    else:
+        rt.kill_actor(actor_handle._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str):
+    """Look up a named actor (reference: ``ray.get_actor``)."""
+    from .actor_api import ActorHandle
+    from .common.ids import ActorID
+    rt = _get_runtime()
+    if rt.is_driver:
+        aid = rt.actor_manager.get_by_name(name)
+    else:
+        raw = rt.get_actor_id_by_name(name)
+        aid = ActorID(raw) if raw else None
+    if aid is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(aid)
 
 
 def available_resources() -> dict[str, float]:

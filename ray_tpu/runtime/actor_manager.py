@@ -1,0 +1,380 @@
+"""Actor lifecycle + ordered method dispatch (GCS actor manager analogue).
+
+Reference parity: ``GcsActorManager`` (registration, restart policy) +
+``ActorTaskSubmitter`` (per-actor ordered queues, direct worker RPC) +
+the dedicated actor worker model (``src/ray/gcs/gcs_server/
+gcs_actor_manager.cc``, ``src/ray/core_worker/transport/
+actor_task_submitter.cc`` — SURVEY.md §3.4; mount empty).
+
+Model: every actor gets a DEDICATED spawned worker (reference behavior).
+Method calls are strictly FIFO per actor: the head of the queue is sent
+only when its ObjectRef deps are ready, preserving submission order even
+when later calls' deps resolve first.  Calls pipeline onto the pipe (the
+worker executes in receive order), bounded by a small in-flight window.
+
+Restart policy: ``max_restarts`` re-runs the creation task on a fresh
+worker (state is lost — reference semantics); in-flight calls at death
+fail with ``ActorDiedError`` unless the actor's ``max_task_retries``
+budget resubmits them; queued-not-yet-sent calls carry over.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..common.ids import ActorID, ObjectID, TaskID
+from .object_ref import ObjectRef
+from .serialization import (ActorDiedError, RayTaskError, deserialize,
+                            serialize)
+
+_MAX_INFLIGHT = 16          # pipelining window per actor
+
+
+class ActorState(enum.Enum):
+    PENDING = 0
+    ALIVE = 1
+    RESTARTING = 2
+    DEAD = 3
+
+
+@dataclass
+class ActorCall:
+    task_id: TaskID
+    method: str
+    args: tuple
+    kwargs: dict
+    num_returns: int
+    retries_left: int = 0
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    cls_id: str
+    init_args: tuple
+    init_kwargs: dict
+    max_restarts: int
+    max_task_retries: int
+    name: str | None
+    state: ActorState = ActorState.PENDING
+    worker = None
+    queue: deque = field(default_factory=deque)
+    inflight: dict = field(default_factory=dict)    # task_id_bin -> ActorCall
+    restarts_left: int = 0
+    graceful_exit: bool = False
+
+
+class ActorManager:
+    def __init__(self, raylet, fn_registry: dict[str, bytes]):
+        self._raylet = raylet
+        self._store = raylet.store
+        self._fn_registry = fn_registry
+        self._lock = threading.RLock()
+        self._actors: dict[ActorID, ActorRecord] = {}
+        self._by_worker: dict[int, ActorID] = {}     # worker index -> actor
+        self._names: dict[str, ActorID] = {}
+
+    # -- creation -----------------------------------------------------------
+    def create_actor(self, actor_id: ActorID, cls_id: str,
+                     cls_bytes: bytes | None, args: tuple, kwargs: dict,
+                     max_restarts: int, max_task_retries: int,
+                     name: str | None = None) -> None:
+        if cls_bytes is not None:
+            self._fn_registry.setdefault(cls_id, cls_bytes)
+        rec = ActorRecord(actor_id, cls_id, args, kwargs, max_restarts,
+                          max_task_retries, name)
+        rec.restarts_left = max_restarts
+        with self._lock:
+            if name is not None:
+                if name in self._names:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._names[name] = actor_id
+            self._actors[actor_id] = rec
+        self._resolve_then(args, lambda: self._start_incarnation(rec))
+
+    def _resolve_then(self, args: tuple, callback) -> None:
+        deps = [a.id for a in args if isinstance(a, ObjectRef)]
+        missing = [d for d in deps if not self._store.contains(d)]
+        if not missing:
+            callback()
+            return
+        state = {"left": len(missing)}
+        state_lock = threading.Lock()
+
+        def on_one(_oid):
+            with state_lock:
+                state["left"] -= 1
+                done = state["left"] == 0
+            if done:
+                callback()
+
+        for d in missing:
+            self._store.on_ready(d, on_one)
+
+    def _start_incarnation(self, rec: ActorRecord) -> None:
+        with self._lock:
+            if rec.state is ActorState.DEAD:    # killed while pending
+                return
+        worker = self._raylet.pool.spawn_dedicated()
+        with self._lock:
+            rec.worker = worker
+            self._by_worker[worker.index] = rec.actor_id
+        payload = serialize((self._materialize_args(rec.init_args),
+                             rec.init_kwargs))
+        worker.send(("fn", rec.cls_id, self._fn_registry[rec.cls_id]))
+        worker.send(("actor_new", rec.actor_id.binary(), rec.cls_id,
+                     payload))
+
+    def _materialize_args(self, args: tuple) -> tuple:
+        out = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                v = self._store.peek(a.id)
+                out.append(v)
+            else:
+                out.append(a)
+        return tuple(out)
+
+    # -- method submission --------------------------------------------------
+    def submit(self, actor_id: ActorID, task_id: TaskID, method: str,
+               args: tuple, kwargs: dict, num_returns: int) -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None or rec.state is ActorState.DEAD:
+                self._fail_call_ids(task_id, num_returns, actor_id)
+                return
+            call = ActorCall(task_id, method, args, kwargs, num_returns,
+                             retries_left=rec.max_task_retries)
+            rec.queue.append(call)
+        self._pump(actor_id)
+
+    def _fail_call_ids(self, task_id: TaskID, num_returns: int,
+                       actor_id: ActorID) -> None:
+        err = RayTaskError(
+            "actor task", "actor is dead",
+            ActorDiedError(f"actor {actor_id.hex()[:12]} is dead"))
+        for i in range(num_returns):
+            self._store.put(ObjectID.for_task_return(task_id, i + 1), err)
+
+    def _pump(self, actor_id: ActorID) -> None:
+        """Send queued calls in order while deps-ready and window open.
+
+        The pop-and-send of each call happens entirely under the manager
+        lock: two concurrent pumps (submitter thread + the actor's reader
+        thread on completion) must not interleave their sends, or the
+        worker would execute out of FIFO order.  Sends are non-blocking
+        pipe writes, so holding the lock across them is cheap.
+        """
+        missing: list = []
+        with self._lock:
+            while True:
+                rec = self._actors.get(actor_id)
+                if rec is None or rec.state is not ActorState.ALIVE:
+                    return
+                if not rec.queue or len(rec.inflight) >= _MAX_INFLIGHT:
+                    return
+                call = rec.queue[0]
+                deps = [a.id for a in call.args
+                        if isinstance(a, ObjectRef)]
+                missing = [d for d in deps
+                           if not self._store.contains(d)]
+                if missing:
+                    break
+                rec.queue.popleft()
+                dep_err = None
+                vals = []
+                for a in call.args:
+                    if isinstance(a, ObjectRef):
+                        v = self._store.peek(a.id)
+                        if isinstance(v, RayTaskError):
+                            dep_err = v
+                            break
+                        vals.append(v)
+                    else:
+                        vals.append(a)
+                if dep_err is not None:
+                    for i in range(call.num_returns):
+                        self._store.put(
+                            ObjectID.for_task_return(call.task_id, i + 1),
+                            dep_err)
+                    continue
+                rec.inflight[call.task_id.binary()] = call
+                payload = serialize((tuple(vals), call.kwargs,
+                                     call.num_returns))
+                rec.worker.send(("actor_call", call.task_id.binary(),
+                                 call.method, payload))
+        # head has missing deps: wake the pump when they land
+        for d in missing:
+            self._store.on_ready(d, lambda _o, a=actor_id: self._pump(a))
+
+    # -- worker frame handling ---------------------------------------------
+    def on_worker_message(self, worker, msg) -> bool:
+        """Returns True if the frame was an actor frame and was handled."""
+        kind = msg[0]
+        if kind == "actor_ready":
+            actor_id = ActorID(msg[1])
+            with self._lock:
+                rec = self._actors.get(actor_id)
+                if rec is not None:
+                    rec.state = ActorState.ALIVE
+            self._pump(actor_id)
+            return True
+        if kind == "actor_init_error":
+            actor_id = ActorID(msg[1])
+            err = deserialize(msg[2])
+            self._on_incarnation_dead(actor_id, init_error=err)
+            return True
+        if kind in ("actor_result", "actor_error"):
+            task_id_bin = msg[1]
+            with self._lock:
+                actor_id = self._by_worker.get(worker.index)
+                rec = self._actors.get(actor_id) if actor_id else None
+                call = rec.inflight.pop(task_id_bin, None) if rec else None
+            if call is None:
+                return True
+            if kind == "actor_result":
+                for i, data in enumerate(msg[2]):
+                    self._store.put(
+                        ObjectID.for_task_return(call.task_id, i + 1),
+                        deserialize(data))
+            else:
+                err = deserialize(msg[2])
+                for i in range(call.num_returns):
+                    self._store.put(
+                        ObjectID.for_task_return(call.task_id, i + 1), err)
+            if actor_id:
+                self._pump(actor_id)
+            return True
+        if kind == "actor_exit":
+            actor_id = ActorID(msg[1])
+            with self._lock:
+                rec = self._actors.get(actor_id)
+                if rec is not None:
+                    rec.graceful_exit = True
+            return True
+        return False
+
+    def on_worker_death(self, worker) -> bool:
+        with self._lock:
+            actor_id = self._by_worker.pop(worker.index, None)
+            if actor_id is None:
+                return False
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return True
+            inflight = list(rec.inflight.values())
+            rec.inflight.clear()
+            graceful = rec.graceful_exit
+            can_restart = (not graceful) and rec.restarts_left != 0
+            if can_restart and rec.restarts_left > 0:
+                rec.restarts_left -= 1
+            rec.state = ActorState.RESTARTING if can_restart \
+                else ActorState.DEAD
+            queued = None if can_restart else list(rec.queue)
+            if not can_restart:
+                rec.queue.clear()
+                if rec.name is not None:
+                    self._names.pop(rec.name, None)
+        # in-flight calls: retry (front of queue, original order) or fail
+        err = RayTaskError(
+            "actor task", "actor died",
+            ActorDiedError(f"actor {actor_id.hex()[:12]} died"))
+        retried = []
+        for call in inflight:
+            if can_restart and call.retries_left != 0:
+                if call.retries_left > 0:
+                    call.retries_left -= 1
+                retried.append(call)
+            else:
+                for i in range(call.num_returns):
+                    self._store.put(
+                        ObjectID.for_task_return(call.task_id, i + 1), err)
+        if can_restart:
+            with self._lock:
+                for call in reversed(retried):
+                    rec.queue.appendleft(call)
+            self._resolve_then(rec.init_args,
+                               lambda: self._restart_incarnation(rec))
+        else:
+            for call in (queued or []):
+                for i in range(call.num_returns):
+                    self._store.put(
+                        ObjectID.for_task_return(call.task_id, i + 1), err)
+        return True
+
+    def _restart_incarnation(self, rec: ActorRecord) -> None:
+        with self._lock:
+            if rec.state is not ActorState.RESTARTING:
+                return
+            rec.state = ActorState.PENDING
+        self._start_incarnation(rec)
+
+    def _on_incarnation_dead(self, actor_id: ActorID,
+                             init_error=None) -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = ActorState.DEAD
+            queued = list(rec.queue)
+            rec.queue.clear()
+            if rec.name is not None:
+                self._names.pop(rec.name, None)
+        err = init_error if init_error is not None else RayTaskError(
+            "actor ctor", "actor failed to start", ActorDiedError())
+        for call in queued:
+            for i in range(call.num_returns):
+                self._store.put(
+                    ObjectID.for_task_return(call.task_id, i + 1), err)
+
+    # -- kill / lookup ------------------------------------------------------
+    def kill(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            if no_restart:
+                rec.restarts_left = 0
+            worker = rec.worker if rec.state is ActorState.ALIVE else None
+            # PENDING (deps unresolved / worker starting) or RESTARTING:
+            # there is no live worker to kill — mark dead directly so the
+            # deferred _start/_restart_incarnation bails out
+            if no_restart and rec.state in (ActorState.PENDING,
+                                            ActorState.RESTARTING):
+                self._mark_dead_locked(rec)
+        if worker is not None:
+            self._raylet.pool.kill_worker(worker)
+
+    def _mark_dead_locked(self, rec: ActorRecord) -> None:
+        rec.state = ActorState.DEAD
+        queued = list(rec.queue)
+        rec.queue.clear()
+        if rec.name is not None:
+            self._names.pop(rec.name, None)
+        err = RayTaskError(
+            "actor task", "actor was killed",
+            ActorDiedError(f"actor {rec.actor_id.hex()[:12]} was killed"))
+        for call in queued:
+            for i in range(call.num_returns):
+                self._store.put(
+                    ObjectID.for_task_return(call.task_id, i + 1), err)
+
+    def get_by_name(self, name: str) -> ActorID | None:
+        with self._lock:
+            return self._names.get(name)
+
+    def state_of(self, actor_id: ActorID) -> ActorState | None:
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            return rec.state if rec else None
+
+    def list_actors(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "ActorID": a.hex(), "State": r.state.name,
+                "Name": r.name, "Pending": len(r.queue),
+                "InFlight": len(r.inflight),
+            } for a, r in self._actors.items()]

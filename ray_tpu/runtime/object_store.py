@@ -106,12 +106,21 @@ class MemoryStore:
             not_ready = [o for o in object_ids if o not in ready_set]
             return ready_list, not_ready
 
-    def get_raw_blocking(self, object_ids: Sequence[ObjectID]) -> list:
+    def get_raw_blocking(self, object_ids: Sequence[ObjectID],
+                         timeout: float | None = None) -> list | None:
         """Blocking fetch WITHOUT error unwrap — stored RayTaskError values
-        are returned as values (the worker-side get re-raises them)."""
+        are returned as values (the worker-side get re-raises them).
+        Returns None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while any(o not in self._objects for o in object_ids):
-                self._cv.wait()
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait()
             return [self._objects[o] for o in object_ids]
 
     def peek(self, object_id: ObjectID):

@@ -48,6 +48,7 @@ class Raylet:
         self._running: dict[bytes, tuple[TaskID, WorkerHandle]] = {}
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
+        self.actor_manager = None   # attached by the driver runtime
         self.pool = WorkerPool(num_workers, self._on_worker_message,
                                self._on_worker_death,
                                on_idle=self._notify_dirty)
@@ -81,6 +82,7 @@ class Raylet:
             if left <= 1:
                 del self._waiting[task_id]
                 self._queue.append(task_id)
+                self._dirty = True
                 self._cv.notify_all()
             else:
                 self._waiting[task_id] = left - 1
@@ -196,6 +198,32 @@ class Raylet:
     # -- worker frame handling (runs on reader threads) ---------------------
     def _on_worker_message(self, worker: WorkerHandle, msg: tuple) -> None:
         kind = msg[0]
+        am = self.actor_manager
+        if am is not None:
+            if am.on_worker_message(worker, msg):
+                return
+            if kind == "actor_create":
+                from ..common.ids import ActorID
+                args, kwargs, max_restarts, max_task_retries, name = \
+                    deserialize(msg[4])
+                am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
+                                kwargs, max_restarts, max_task_retries, name)
+                return
+            if kind == "actor_submit":
+                from ..common.ids import ActorID
+                args, kwargs, num_returns = deserialize(msg[4])
+                am.submit(ActorID(msg[1]), TaskID(msg[2]), msg[3], args,
+                          kwargs, num_returns)
+                return
+            if kind == "actor_kill":
+                from ..common.ids import ActorID
+                am.kill(ActorID(msg[1]), no_restart=msg[2])
+                return
+            if kind == "named_actor":
+                aid = am.get_by_name(msg[1])
+                worker.send(("named_actor_reply",
+                             aid.binary() if aid else None))
+                return
         if kind in ("result", "error"):
             task_id_bin = msg[1]
             with self._cv:
@@ -218,9 +246,10 @@ class Raylet:
             self._notify_dirty()
         elif kind == "get":
             oids = [self._oid(b) for b in msg[1]]
+            timeout = msg[2] if len(msg) > 2 else None
             if all(self.store.contains(o) for o in oids):
-                worker.send(("get_reply",
-                             serialize(self.store.get_raw_blocking(oids))))
+                worker.send(("get_reply", serialize(
+                    ("ok", self.store.get_raw_blocking(oids)))))
                 return
             # Blocking get: release the task's resources while the worker
             # waits (reference: CPU is returned during ray.get so dependent
@@ -237,14 +266,17 @@ class Raylet:
                 self.crm.add_back(self.row, rec.spec.resources)
                 self._notify_dirty()
             self.pool.grow_for_blocked()
-            values = self.store.get_raw_blocking(oids)
+            values = self.store.get_raw_blocking(oids, timeout=timeout)
             # re-acquire before resuming (waits for capacity like the
             # reference's worker unblock path; bounded oversubscription is
             # preferred over a stuck reader if capacity never frees)
             if rec is not None:
                 self._reacquire(rec.spec.resources)
             worker.blocked = False
-            worker.send(("get_reply", serialize(values)))
+            if values is None:
+                worker.send(("get_reply", serialize(("timeout", None))))
+            else:
+                worker.send(("get_reply", serialize(("ok", values))))
         elif kind == "put":
             self.store.put(self._oid(msg[1]), deserialize(msg[2]))
         elif kind == "submit":
@@ -272,6 +304,9 @@ class Raylet:
             time.sleep(0.002)
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
+        if self.actor_manager is not None and \
+                self.actor_manager.on_worker_death(worker):
+            return
         task_id_bin = worker.leased_task
         if task_id_bin is None:
             return

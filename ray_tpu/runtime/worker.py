@@ -42,6 +42,18 @@ class WorkerApiContext:
         self._conn = conn
         self._task_id: TaskID | None = None
         self._put_index = 0
+        # frames that arrived while this worker was waiting for a reply
+        # (pipelined actor calls land mid-get); the main loop drains them
+        # in order after the current task finishes
+        from collections import deque
+        self.pending_frames = deque()
+
+    def _recv_reply(self, expected_kind: str):
+        while True:
+            msg = self._conn.recv()
+            if msg[0] == expected_kind:
+                return msg
+            self.pending_frames.append(msg)
 
     # -- task lifecycle (called by the exec loop) ---------------------------
     def begin_task(self, task_id: TaskID):
@@ -57,10 +69,13 @@ class WorkerApiContext:
 
     # -- API ----------------------------------------------------------------
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
-        self._conn.send(("get", [r.binary() for r in refs]))
-        kind, payload = self._conn.recv()
-        assert kind == "get_reply", kind
-        values = deserialize(payload)
+        self._conn.send(("get", [r.binary() for r in refs], timeout))
+        _, payload = self._recv_reply("get_reply")
+        status, values = deserialize(payload)
+        if status == "timeout":
+            from .object_store import GetTimeoutError
+            raise GetTimeoutError(
+                f"get timed out after {timeout}s inside worker")
         for v in values:
             if isinstance(v, RayTaskError):
                 raise v.cause if v.cause is not None else v
@@ -83,6 +98,28 @@ class WorkerApiContext:
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
         self._conn.send(("submit", serialize(spec), fn_id, fn_bytes))
 
+    # -- actor API (frames handled by the driver's ActorManager) ------------
+    def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
+                     args, kwargs, max_restarts: int, max_task_retries: int,
+                     name: str | None):
+        self._conn.send(("actor_create", actor_id.binary(), cls_id,
+                         cls_bytes, serialize(
+                             (args, kwargs, max_restarts, max_task_retries,
+                              name))))
+
+    def submit_actor_call(self, actor_id, task_id, method: str, args,
+                          kwargs, num_returns: int):
+        self._conn.send(("actor_submit", actor_id.binary(),
+                         task_id.binary(), method,
+                         serialize((args, kwargs, num_returns))))
+
+    def kill_actor(self, actor_id, no_restart: bool = True):
+        self._conn.send(("actor_kill", actor_id.binary(), no_restart))
+
+    def get_actor_id_by_name(self, name: str):
+        self._conn.send(("named_actor", name))
+        return self._recv_reply("named_actor_reply")[1]
+
 
 def worker_main(conn, worker_index: int) -> None:
     """Entry point of a spawned worker process."""
@@ -95,13 +132,18 @@ def worker_main(conn, worker_index: int) -> None:
     ctx = WorkerApiContext(conn)
     api._set_runtime(ctx)
     fn_table: dict[str, object] = {}
+    actor_instance = None            # dedicated worker: one actor
+    actor_id_bin = None
     conn.send(("ready",))
 
     while True:
-        try:
-            msg = conn.recv()
-        except (EOFError, OSError):
-            break
+        if ctx.pending_frames:
+            msg = ctx.pending_frames.popleft()
+        else:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
         kind = msg[0]
         if kind == "fn":
             fn_table[msg[1]] = deserialize(msg[2])
@@ -135,6 +177,56 @@ def worker_main(conn, worker_index: int) -> None:
                         RayTaskError(name, err.tb, None))))
             finally:
                 ctx.end_task()
+        elif kind == "actor_new":
+            _, actor_id_bin, cls_id, payload = msg
+            args, kwargs = deserialize(payload)
+            cls = fn_table[cls_id]
+            ctx.begin_task(TaskID.deterministic(actor_id_bin,
+                                                _nil_actor()))
+            try:
+                actor_instance = cls(*args, **kwargs)
+                conn.send(("actor_ready", actor_id_bin))
+            except BaseException as e:  # noqa: BLE001
+                conn.send(("actor_init_error", actor_id_bin, serialize(
+                    RayTaskError.from_exception(
+                        getattr(cls, "__name__", "actor") + ".__init__",
+                        e))))
+            finally:
+                ctx.end_task()
+        elif kind == "actor_call":
+            _, task_id_bin, method, payload = msg
+            args, kwargs, num_returns = deserialize(payload)
+            if method == "__ray_terminate__":
+                conn.send(("actor_exit", actor_id_bin))
+                conn.send(("actor_result", task_id_bin, [serialize(None)]))
+                break
+            ctx.begin_task(TaskID(task_id_bin))
+            try:
+                bound = getattr(actor_instance, method)
+                out = bound(*args, **kwargs)
+                if num_returns == 1:
+                    results = [out]
+                elif num_returns == 0:
+                    results = []
+                else:
+                    results = list(out)
+                    if len(results) != num_returns:
+                        raise ValueError(
+                            f"actor method {method} declared num_returns="
+                            f"{num_returns} but returned {len(results)} "
+                            "values")
+                conn.send(("actor_result", task_id_bin,
+                           [serialize(r) for r in results]))
+            except BaseException as e:  # noqa: BLE001
+                conn.send(("actor_error", task_id_bin, serialize(
+                    RayTaskError.from_exception(method, e))))
+            finally:
+                ctx.end_task()
         elif kind == "shutdown":
             break
     sys.exit(0)
+
+
+def _nil_actor():
+    from ..common.ids import ActorID, JobID
+    return ActorID.nil_for_job(JobID.from_int(0))

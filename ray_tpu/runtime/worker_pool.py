@@ -34,6 +34,7 @@ class WorkerHandle:
         self.ready = False
         self.dead = False
         self.blocked = False                # inside a blocking get
+        self.dedicated = False              # actor worker: never in idle set
         self.leased_task = None             # task_id_bin while executing
         self.fn_cache: set[str] = set()
 
@@ -73,10 +74,10 @@ class WorkerPool:
         for _ in range(self._num):
             self._spawn_one()
 
-    def _spawn_one(self) -> None:
+    def _spawn_one(self, dedicated: bool = False) -> WorkerHandle | None:
         with self._lock:
             if self._shutdown:
-                return
+                return None
             index = self._next_index
             self._next_index += 1
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
@@ -92,10 +93,21 @@ class WorkerPool:
                 os.environ.update(saved)
         child_conn.close()
         handle = WorkerHandle(index, proc, parent_conn)
+        handle.dedicated = dedicated
         with self._lock:
             self._workers.append(handle)
         threading.Thread(target=self._reader, args=(handle,),
                          daemon=True, name=f"rt-reader-{index}").start()
+        return handle
+
+    def spawn_dedicated(self) -> WorkerHandle:
+        """Spawn a worker that is never leased from the idle set — the
+        dedicated actor-worker model (reference: each actor gets its own
+        worker process)."""
+        handle = self._spawn_one(dedicated=True)
+        if handle is None:
+            raise RuntimeError("pool is shut down")
+        return handle
 
     def _reader(self, handle: WorkerHandle) -> None:
         while True:
@@ -106,9 +118,11 @@ class WorkerPool:
             if msg[0] == "ready":
                 with self._cv:
                     handle.ready = True
-                    self._idle.append(handle)
+                    if not handle.dedicated:
+                        self._idle.append(handle)
                     self._cv.notify_all()
-                self._on_idle()
+                if not handle.dedicated:
+                    self._on_idle()
                 continue
             try:
                 self._on_message(handle, msg)
@@ -122,7 +136,8 @@ class WorkerPool:
             self._cv.notify_all()
         if not self._shutdown:
             self._on_death(handle)
-            self._spawn_one()               # keep the pool at strength
+            if not handle.dedicated:
+                self._spawn_one()           # keep the task pool at strength
 
     # -- leasing ------------------------------------------------------------
     def pop_idle(self) -> WorkerHandle | None:
@@ -163,7 +178,8 @@ class WorkerPool:
         stop counting toward the soft limit, and the pool starts
         replacements on demand — SURVEY §3.2 lease notes)."""
         with self._lock:
-            alive = [h for h in self._workers if not h.dead]
+            alive = [h for h in self._workers
+                     if not h.dead and not h.dedicated]
             unblocked = sum(not h.blocked for h in alive)
             if self._idle or unblocked >= self._num \
                     or len(alive) >= self._num * max_factor:
