@@ -114,28 +114,34 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         from .common.config import get_config
+        from .common.resources import ResourceRequest
         rt = _runtime()
         opts = self._options
         max_restarts = opts.get(
             "max_restarts", get_config().actor_max_restarts_default)
         max_task_retries = opts.get("max_task_retries", 0)
         name = opts.get("name")
+        res = dict(opts.get("resources") or {})
+        if "num_cpus" in opts:
+            res["CPU"] = opts["num_cpus"]
+        if "num_gpus" in opts:
+            res["GPU"] = opts["num_gpus"]
+        # default: actors hold no resources while alive (reference default
+        # is num_cpus=0 for an actor's lifetime)
+        resources = ResourceRequest(res)
         cls_id, cls_bytes = self._materialize()
         if rt.is_driver:
             actor_id = ActorID.of(rt.job_id)
-            rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
-                            max_restarts, max_task_retries, name)
         else:
             cur = rt.current_task_id
             job_id = cur.job_id() if cur else JobID.from_int(0)
             actor_id = ActorID.of(job_id)
-            rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
-                            max_restarts, max_task_retries, name)
+        rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
+                        max_restarts, max_task_retries, name, resources)
         return ActorHandle(actor_id)
 
 
 def make_actor_class(cls: type, options: dict[str, Any]) -> ActorClass:
-    opts = dict(options)
-    if "max_restarts" in opts and opts["max_restarts"] == -1:
-        opts["max_restarts"] = -1           # infinite restarts
-    return ActorClass(cls, options=opts)
+    # max_restarts=-1 (infinite) passes through unchanged; the restart
+    # budget check in ActorManager.on_worker_death treats != 0 as usable
+    return ActorClass(cls, options=dict(options))

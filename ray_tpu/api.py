@@ -14,15 +14,11 @@ import threading
 from typing import Any, Callable
 
 from .common.config import Config, get_config
-from .common.ids import JobID, NodeID, TaskID
-from .common.resources import NodeResources, ResourceRequest, from_cu
-from .common.task_spec import (DEFAULT_STRATEGY, SchedulingStrategy,
-                               TaskSpec, TaskType)
+from .common.ids import JobID, TaskID
+from .common.resources import ResourceRequest, from_cu
+from .common.task_spec import DEFAULT_STRATEGY, TaskSpec, TaskType
 from .runtime.object_ref import ObjectRef
-from .runtime.object_store import MemoryStore
-from .runtime.raylet import Raylet
 from .runtime.serialization import serialize
-from .scheduling.cluster_resources import ClusterResourceManager
 
 _lock = threading.RLock()
 _runtime: "DriverRuntime | Any | None" = None   # driver or WorkerApiContext
@@ -40,30 +36,37 @@ def _get_runtime():
 
 
 class DriverRuntime:
-    """The in-driver runtime: store + raylet + function registry."""
+    """The in-driver runtime: a (possibly one-node) simulated cluster."""
 
     is_driver = True
 
-    def __init__(self, resources: dict[str, float], num_workers: int,
-                 job_id: JobID):
+    def __init__(self, job_id: JobID,
+                 resources: dict[str, float] | None = None,
+                 num_workers: int | None = None, cluster=None):
+        from .cluster_utils import Cluster
+        from .runtime.actor_manager import ActorManager
         self.job_id = job_id
         self.driver_task_id = TaskID.for_task(job_id)
         self._put_index = 0
         self._put_lock = threading.Lock()
-        self.store = MemoryStore()
-        self.fn_registry: dict[str, bytes] = {}
-        self.crm = ClusterResourceManager()
-        self.node_id = NodeID.from_random()
-        self.crm.add_node(self.node_id, NodeResources(resources))
-        self.raylet = Raylet(self.node_id, self.crm, self.store,
-                             num_workers, self.fn_registry)
-        from .runtime.actor_manager import ActorManager
-        self.actor_manager = ActorManager(self.raylet, self.fn_registry)
-        self.raylet.actor_manager = self.actor_manager
-        self.raylet.start()
-        # block until the pool is at strength: deterministic parallelism
-        # from the first task (reference prestarts workers the same way)
-        self.raylet.pool.wait_ready(num_workers, timeout=60.0)
+        self._owns_cluster = cluster is None
+        if cluster is None:
+            cluster = Cluster()
+            self.actor_manager = ActorManager(cluster)
+            cluster.actor_manager = self.actor_manager
+            cluster.add_node(resources=resources, num_workers=num_workers)
+        else:
+            if cluster.actor_manager is None:
+                cluster.actor_manager = ActorManager(cluster)
+                for raylet in cluster.raylets.values():
+                    raylet.actor_manager = cluster.actor_manager
+            self.actor_manager = cluster.actor_manager
+        self.cluster = cluster
+        self.store = cluster.store
+        self.fn_registry = cluster.fn_registry
+        self.crm = cluster.crm
+        self.raylet = cluster.head()
+        self.node_id = self.raylet.node_id
 
     # -- API ----------------------------------------------------------------
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
@@ -92,13 +95,19 @@ class DriverRuntime:
         self.raylet.submit(spec)
 
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
-                     max_restarts, max_task_retries, name) -> None:
+                     max_restarts, max_task_retries, name,
+                     resources=None) -> None:
         self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
                                         kwargs, max_restarts,
-                                        max_task_retries, name)
+                                        max_task_retries, name,
+                                        resources=resources)
 
     def shutdown(self) -> None:
-        self.raylet.stop()
+        # an adopted (caller-owned) cluster stays up across shutdown, the
+        # reference's detach semantics; the caller stops it via
+        # cluster.stop()
+        if self._owns_cluster:
+            self.cluster.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +254,11 @@ def _normalize_resources(options: dict) -> dict[str, float]:
 
 def init(resources: dict[str, float] | None = None,
          num_workers: int | None = None,
-         system_config: dict | None = None) -> None:
+         system_config: dict | None = None,
+         cluster=None) -> None:
+    """Start the runtime.  ``cluster=`` adopts an existing simulated
+    multi-node ``cluster_utils.Cluster`` (the reference's
+    ``ray.init(address=cluster.address)`` pattern)."""
     global _runtime
     with _lock:
         if _runtime is not None:
@@ -259,7 +272,8 @@ def init(resources: dict[str, float] | None = None,
         if num_workers is None:
             num_workers = cfg.num_workers_soft_limit or \
                 min(int(resources.get("CPU", ncpu)), ncpu)
-        _runtime = DriverRuntime(resources, num_workers, JobID.next())
+        _runtime = DriverRuntime(JobID.next(), resources, num_workers,
+                                 cluster=cluster)
 
 
 def is_initialized() -> bool:

@@ -1,0 +1,94 @@
+"""Simulated multi-node cluster — the ``cluster_utils.Cluster`` analogue.
+
+Reference parity: upstream's ``python/ray/cluster_utils.py::Cluster`` starts
+N real raylets + one GCS on a single machine with fabricated ``--resources``
+JSON; all multi-node scheduling/spillback/PG/failure tests run against it
+(SURVEY.md §4 simulated multi-node tier; mount empty).
+
+Here a node = one ``Raylet`` (its own worker-process pool + its row in the
+shared ``ClusterResourceManager``).  The process-local shared CRM/store IS
+the GCS + ray_syncer of the single-host form: every raylet schedules
+against the same authoritative resource view, so spillback converges in one
+hop (the policy is deterministic in global row order — the destination
+raylet recomputes the same answer and dispatches locally).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .common.ids import NodeID
+from .common.resources import NodeResources
+from .runtime.object_store import MemoryStore
+from .runtime.raylet import Raylet
+from .runtime.task_manager import TaskManager
+from .scheduling.cluster_resources import ClusterResourceManager
+
+
+class Cluster:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.crm = ClusterResourceManager()
+        self.store = MemoryStore()
+        self.task_manager = TaskManager()     # ownership is driver-central
+        self.fn_registry: dict[str, bytes] = {}
+        self.raylets: dict[int, Raylet] = {}  # row -> raylet
+        self.actor_manager = None             # attached by the runtime
+        self._head_row: int | None = None
+
+    # -- topology -----------------------------------------------------------
+    def add_node(self, resources: dict[str, float] | None = None,
+                 num_workers: int = 2,
+                 labels: dict[str, str] | None = None,
+                 wait: bool = True) -> NodeID:
+        resources = resources or {"CPU": 2, "memory": 2}
+        node_id = NodeID.from_random()
+        with self._lock:
+            row = self.crm.add_node(node_id,
+                                    NodeResources(resources, labels))
+            raylet = Raylet(node_id, self, num_workers)
+            raylet.actor_manager = self.actor_manager
+            self.raylets[row] = raylet
+            if self._head_row is None:
+                self._head_row = row
+        raylet.start()
+        if wait and num_workers:
+            raylet.pool.wait_ready(num_workers, timeout=60.0)
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node death: resources vanish, running tasks retried
+        elsewhere (or failed), queued tasks re-routed, actors restarted or
+        declared dead (SURVEY §5.3 failure semantics)."""
+        with self._lock:
+            row = self.crm.row_of(node_id)
+            if row is None or row == self._head_row:
+                raise ValueError("cannot remove head node or unknown node")
+            raylet = self.raylets.pop(row)
+            self.crm.remove_node(node_id)
+        raylet.drain_for_removal(self.head())
+
+    def head(self) -> Raylet:
+        return self.raylets[self._head_row]
+
+    def raylet_of_row(self, row: int) -> Raylet | None:
+        with self._lock:
+            return self.raylets.get(row)
+
+    # -- routing (spillback) ------------------------------------------------
+    def route_local(self, row: int, task_id) -> bool:
+        """Deliver a PLACED task into the target node's local dispatch
+        queue (the task is scheduled exactly once)."""
+        target = self.raylet_of_row(row)
+        if target is None:
+            return False
+        target.enqueue_local(task_id)
+        return True
+
+    # -- teardown -----------------------------------------------------------
+    def stop(self) -> None:
+        with self._lock:
+            raylets = list(self.raylets.values())
+            self.raylets.clear()
+        for r in raylets:
+            r.stop()

@@ -63,6 +63,13 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         bool, True,
         "Evaluate batched placement on the TPU kernel; False forces the CPU "
         "oracle everywhere (debugging / parity bisection)."),
+    "scheduler_device_batch_min": (
+        int, 4096,
+        "Minimum uniform-strategy backlog routed to the device kernel in "
+        "one round; smaller rounds use the (bit-identical) CPU policy. "
+        "Default is the break-even for a TUNNELED dev chip (~90 ms/call "
+        "vs ~25 us/placement on CPU); drop to a few hundred when the TPU "
+        "is host-local."),
     # -- object store -------------------------------------------------------
     "object_store_memory_mb": (
         int, 512,

@@ -26,6 +26,8 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..common.ids import ActorID, ObjectID, TaskID
+from ..common.resources import ResourceRequest
+from ..scheduling.policy import HybridSchedulingPolicy, SchedulingOptions
 from .object_ref import ObjectRef
 from .serialization import (ActorDiedError, RayTaskError, deserialize,
                             serialize)
@@ -59,8 +61,11 @@ class ActorRecord:
     max_restarts: int
     max_task_retries: int
     name: str | None
+    resources: ResourceRequest = field(default_factory=ResourceRequest)
     state: ActorState = ActorState.PENDING
     worker = None
+    pool = None                 # worker pool of the placement node
+    row: int = -1               # placement node row (resource accounting)
     queue: deque = field(default_factory=deque)
     inflight: dict = field(default_factory=dict)    # task_id_bin -> ActorCall
     restarts_left: int = 0
@@ -68,24 +73,25 @@ class ActorRecord:
 
 
 class ActorManager:
-    def __init__(self, raylet, fn_registry: dict[str, bytes]):
-        self._raylet = raylet
-        self._store = raylet.store
-        self._fn_registry = fn_registry
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._store = cluster.store
+        self._fn_registry = cluster.fn_registry
         self._lock = threading.RLock()
         self._actors: dict[ActorID, ActorRecord] = {}
-        self._by_worker: dict[int, ActorID] = {}     # worker index -> actor
         self._names: dict[str, ActorID] = {}
 
     # -- creation -----------------------------------------------------------
     def create_actor(self, actor_id: ActorID, cls_id: str,
                      cls_bytes: bytes | None, args: tuple, kwargs: dict,
                      max_restarts: int, max_task_retries: int,
-                     name: str | None = None) -> None:
+                     name: str | None = None,
+                     resources: ResourceRequest | None = None) -> None:
         if cls_bytes is not None:
             self._fn_registry.setdefault(cls_id, cls_bytes)
         rec = ActorRecord(actor_id, cls_id, args, kwargs, max_restarts,
-                          max_task_retries, name)
+                          max_task_retries, name,
+                          resources=resources or ResourceRequest())
         rec.restarts_left = max_restarts
         with self._lock:
             if name is not None:
@@ -118,10 +124,29 @@ class ActorManager:
         with self._lock:
             if rec.state is ActorState.DEAD:    # killed while pending
                 return
-        worker = self._raylet.pool.spawn_dedicated()
+        # placement: actors schedule like tasks, through the hybrid policy
+        # over the shared resource view (reference: GcsActorScheduler uses
+        # the same ClusterTaskManager lease path, SURVEY.md 3.4)
+        crm = self._cluster.crm
+        snapshot = crm.snapshot()
+        req = rec.resources.dense(crm.resource_index,
+                                  snapshot.totals.shape[1])
+        row = HybridSchedulingPolicy().schedule(snapshot, req,
+                                                SchedulingOptions())
+        raylet = self._cluster.raylet_of_row(row) if row >= 0 else None
+        if raylet is None:
+            self._on_incarnation_dead(rec.actor_id, init_error=RayTaskError(
+                "actor ctor", "no feasible node for actor resources "
+                f"{rec.resources.to_dict()}", ActorDiedError()))
+            return
+        if not rec.resources.is_empty():
+            crm.subtract(row, rec.resources)
+        worker = raylet.pool.spawn_dedicated()
+        worker.actor_binding = rec.actor_id
         with self._lock:
             rec.worker = worker
-            self._by_worker[worker.index] = rec.actor_id
+            rec.pool = raylet.pool
+            rec.row = row
         payload = serialize((self._materialize_args(rec.init_args),
                              rec.init_kwargs))
         worker.send(("fn", rec.cls_id, self._fn_registry[rec.cls_id]))
@@ -229,8 +254,8 @@ class ActorManager:
             return True
         if kind in ("actor_result", "actor_error"):
             task_id_bin = msg[1]
+            actor_id = getattr(worker, "actor_binding", None)
             with self._lock:
-                actor_id = self._by_worker.get(worker.index)
                 rec = self._actors.get(actor_id) if actor_id else None
                 call = rec.inflight.pop(task_id_bin, None) if rec else None
             if call is None:
@@ -258,13 +283,16 @@ class ActorManager:
         return False
 
     def on_worker_death(self, worker) -> bool:
+        actor_id = getattr(worker, "actor_binding", None)
+        if actor_id is None:
+            return False
         with self._lock:
-            actor_id = self._by_worker.pop(worker.index, None)
-            if actor_id is None:
-                return False
             rec = self._actors.get(actor_id)
             if rec is None:
                 return True
+            if rec.row >= 0 and not rec.resources.is_empty():
+                self._cluster.crm.add_back(rec.row, rec.resources)
+                rec.row = -1
             inflight = list(rec.inflight.values())
             rec.inflight.clear()
             graceful = rec.graceful_exit
@@ -346,7 +374,9 @@ class ActorManager:
                                             ActorState.RESTARTING):
                 self._mark_dead_locked(rec)
         if worker is not None:
-            self._raylet.pool.kill_worker(worker)
+            pool = rec.pool if rec.pool is not None \
+                else self._cluster.head().pool
+            pool.kill_worker(worker)
 
     def _mark_dead_locked(self, rec: ActorRecord) -> None:
         rec.state = ActorState.DEAD
@@ -361,6 +391,20 @@ class ActorManager:
             for i in range(call.num_returns):
                 self._store.put(
                     ObjectID.for_task_return(call.task_id, i + 1), err)
+
+    def fail_actors_on_pool(self, pool) -> None:
+        """Node removal: every actor placed on this pool loses its worker.
+        The pool's shutdown suppresses reader-thread death callbacks, so
+        the raylet drain calls this explicitly — restart policy applies as
+        for any worker death."""
+        with self._lock:
+            victims = [r.worker for r in self._actors.values()
+                       if r.pool is pool and r.worker is not None
+                       and r.state in (ActorState.ALIVE,
+                                       ActorState.PENDING)]
+        for worker in victims:
+            worker.dead = True
+            self.on_worker_death(worker)
 
     def get_by_name(self, name: str) -> ActorID | None:
         with self._lock:

@@ -1,18 +1,22 @@
 """Raylet: per-node task queueing, scheduling, dispatch, and completion.
 
 Reference parity: the raylet's ``NodeManager`` + ``ClusterTaskManager``
-(queue by scheduling class, schedule per event-loop turn) +
-``LocalTaskManager`` (resource allocation + worker handout) +
-``DependencyManager`` (hold tasks until args exist) — ``src/ray/raylet/``,
-SURVEY.md §1 layer 4 / §3.2 hot loop; mount empty.
+(queue by scheduling class, schedule per event-loop turn via
+``ClusterResourceScheduler::GetBestSchedulableNode``) + ``LocalTaskManager``
+(resource allocation + worker handout) + ``DependencyManager`` (hold tasks
+until args exist) + spillback to the chosen remote raylet — ``src/ray/
+raylet/``, SURVEY.md §1 layer 4 / §3.2 hot loop; mount empty.
 
-Single-process form: one Raylet owns the local ``ClusterResourceManager``
-row, a ``WorkerPool`` of spawned processes, and the in-process object
-store.  The scheduling loop is an event-driven thread (condition variable,
-not a busy tick): it wakes on task arrival, dependency readiness, worker
-release, and resource release — the same wake set as the reference's asio
-event loop.  The simulated multi-node harness instantiates N of these over
-one shared resource view.
+TPU-first: when a scheduling round's batch is big enough and uniformly
+default-strategy, the WHOLE batch is placed by the device water-fill kernel
+(``ray_tpu.ops.schedule_grouped``) in one call — the north-star data plane
+running inside the live runtime.  Small or mixed batches take the per-task
+CPU policy, which is bit-identical by the parity contract, so the switch is
+invisible to callers (``scheduler_device_backend`` config).
+
+The scheduling loop is event-driven (condition variable, not a busy tick):
+it wakes on task arrival, dependency readiness, worker release, and
+resource release — the same wake set as the reference's asio event loop.
 """
 
 from __future__ import annotations
@@ -20,35 +24,39 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+import numpy as np
+
+from ..common.config import get_config
 from ..common.ids import TaskID
 from ..common.resources import ResourceRequest
-from ..common.task_spec import TaskSpec
-from ..scheduling.cluster_resources import ClusterResourceManager
+from ..common.task_spec import SchedulingStrategyKind
+from ..scheduling.policy import (CompositeSchedulingPolicy,
+                                 SchedulingOptions, SchedulingType)
 from .object_ref import ObjectRef
-from .object_store import MemoryStore
 from .serialization import (RayTaskError, WorkerCrashedError, deserialize,
                             serialize)
-from .task_manager import TaskManager
 from .worker_pool import WorkerHandle, WorkerPool
 
 
 class Raylet:
-    def __init__(self, node_id, crm: ClusterResourceManager,
-                 store: MemoryStore, num_workers: int,
-                 fn_registry: dict[str, bytes]):
+    def __init__(self, node_id, cluster, num_workers: int):
         self.node_id = node_id
-        self.crm = crm
-        self.row = crm.row_of(node_id)
-        self.store = store
-        self.task_manager = TaskManager()
-        self._fn_registry = fn_registry
+        self.cluster = cluster
+        self.crm = cluster.crm
+        self.row = self.crm.row_of(node_id)
+        self.store = cluster.store
+        self.task_manager = cluster.task_manager
+        self._fn_registry = cluster.fn_registry
+        self._policy = CompositeSchedulingPolicy()
         self._cv = threading.Condition()
-        self._queue: deque[TaskID] = deque()
+        self._queue: deque[TaskID] = deque()        # awaiting PLACEMENT
+        self._local_queue: deque[TaskID] = deque()  # placed here, await dispatch
+        self._planned_cu = None     # dense planned-load vector (lazy width)
         self._waiting: dict[TaskID, int] = {}   # task -> missing dep count
         self._running: dict[bytes, tuple[TaskID, WorkerHandle]] = {}
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
-        self.actor_manager = None   # attached by the driver runtime
+        self.actor_manager = None   # attached by the runtime/cluster
         self.pool = WorkerPool(num_workers, self._on_worker_message,
                                self._on_worker_death,
                                on_idle=self._notify_dirty)
@@ -60,7 +68,7 @@ class Raylet:
         self._thread.start()
 
     # -- submission ---------------------------------------------------------
-    def submit(self, spec: TaskSpec) -> list[ObjectRef]:
+    def submit(self, spec) -> list[ObjectRef]:
         rec = self.task_manager.register(spec)
         deps = [a.id for a in spec.args if isinstance(a, ObjectRef)]
         missing = [d for d in deps if not self.store.contains(d)]
@@ -74,18 +82,67 @@ class Raylet:
             self._enqueue(spec.task_id)
         return [ObjectRef(oid) for oid in rec.return_ids]
 
+    def enqueue_forwarded(self, task_id: TaskID) -> None:
+        """Arrival needing (re-)placement (deps already resolved)."""
+        self._enqueue(task_id)
+
+    def enqueue_local(self, task_id: TaskID) -> None:
+        """Placement decided: this node owns the task until dispatch.
+
+        Tasks are scheduled ONCE (reference: ClusterTaskManager places,
+        then the task waits in LocalTaskManager for workers/resources —
+        it is not re-scheduled on every worker event).  The planned load
+        is visible to subsequent scheduling rounds so they do not
+        over-assign this node."""
+        rec = self.task_manager.get(task_id)
+        with self._cv:
+            if rec is not None:
+                self._planned_add(rec.spec.resources, 1)
+            self._local_queue.append(task_id)
+            self._dirty = True
+            self._cv.notify_all()
+
+    def _planned_add(self, resources, sign: int) -> None:
+        # caller holds _cv
+        vec = resources.dense(self.crm.resource_index,
+                              self.crm.avail.shape[1])
+        if self._planned_cu is None or \
+                self._planned_cu.shape[0] < vec.shape[0]:
+            import numpy as _np
+            new = _np.zeros(vec.shape[0], dtype=_np.int64)
+            if self._planned_cu is not None:
+                new[:self._planned_cu.shape[0]] = self._planned_cu
+            self._planned_cu = new
+        if sign > 0:
+            self._planned_cu[:vec.shape[0]] += vec
+        else:
+            self._planned_cu[:vec.shape[0]] -= vec
+
+    def planned_snapshot(self):
+        with self._cv:
+            return None if self._planned_cu is None \
+                else self._planned_cu.copy()
+
     def _dep_ready(self, task_id: TaskID) -> None:
+        fallback = None
         with self._cv:
             left = self._waiting.get(task_id)
             if left is None:
                 return
             if left <= 1:
                 del self._waiting[task_id]
-                self._queue.append(task_id)
-                self._dirty = True
-                self._cv.notify_all()
+                if self._stopped:
+                    # node was removed while this task awaited deps: hand
+                    # it to the surviving raylet recorded at drain time
+                    fallback = getattr(self, "_removal_fallback", None)
+                else:
+                    self._queue.append(task_id)
+                    self._dirty = True
+                    self._cv.notify_all()
             else:
                 self._waiting[task_id] = left - 1
+        if fallback is not None:
+            fallback.enqueue_forwarded(task_id)
 
     def _enqueue(self, task_id: TaskID) -> None:
         with self._cv:
@@ -106,42 +163,197 @@ class Raylet:
         instead of busy-spinning."""
         while True:
             with self._cv:
-                while not self._stopped and not (self._dirty and self._queue):
+                while not self._stopped and not (
+                        self._dirty and (self._queue or self._local_queue)):
                     self._cv.wait()
                 if self._stopped:
                     return
                 self._dirty = False
                 batch = list(self._queue)
                 self._queue.clear()
-            leftover = self._dispatch_batch(batch)
-            if leftover:
-                with self._cv:
-                    # keep arrival order: leftovers go back to the front
-                    self._queue.extendleft(reversed(leftover))
+            if batch:
+                leftover = self._place_batch(batch)
+                if leftover:
+                    with self._cv:
+                        # infeasible-now tasks park at the front, in order
+                        self._queue.extendleft(reversed(leftover))
+            self._drain_local()
 
-    def _dispatch_batch(self, batch: list[TaskID]) -> list[TaskID]:
+    # -- batch scheduling ---------------------------------------------------
+    def _schedule_rows(self, batch: list) -> list[int]:
+        """Choose a node row for every task record in the batch.
+
+        Returns one row per record (-1 = infeasible/park).  Uses the device
+        water-fill kernel for large uniform batches, the CPU policy
+        otherwise — bit-identical placements either way (parity contract).
+        """
+        cfg = get_config()
+        specs = [rec.spec for rec in batch]
+        uniform = all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
+                      for s in specs)
+        if cfg.scheduler_device_backend and uniform and \
+                len(batch) >= cfg.scheduler_device_batch_min:
+            return self._schedule_rows_device(specs)
+        # per-task CPU policy on a snapshot (sequential within the round)
+        snapshot = self._effective_snapshot()
+        rows = []
+        for spec in specs:
+            req = spec.resources.dense(self.crm.resource_index,
+                                       snapshot.totals.shape[1])
+            rows.append(self._policy.schedule(
+                snapshot, req, self._options_for(spec)))
+        return rows
+
+    def _schedule_rows_device(self, specs: list) -> list[int]:
+        """One device water-fill call places the whole batch (north star)."""
+        import jax.numpy as jnp
+
+        from ..ops import schedule_grouped
+        from ..scheduling.contract import threshold_fp
+
+        snapshot = self._effective_snapshot()
+        totals, avail, mask = (snapshot.totals, snapshot.avail,
+                               snapshot.node_mask)
+        width = totals.shape[1]
+        groups: dict[tuple, int] = {}
+        reqs: list[np.ndarray] = []
+        counts: list[int] = []
+        task_group = np.empty(len(specs), dtype=np.int32)
+        for t, spec in enumerate(specs):
+            key = spec.scheduling_class()
+            g = groups.get(key)
+            if g is None:
+                g = len(reqs)
+                groups[key] = g
+                reqs.append(spec.resources.dense(self.crm.resource_index,
+                                                 width))
+                counts.append(0)
+            counts[g] += 1
+            task_group[t] = g
+        G, N = len(reqs), totals.shape[0]
+        # pad the class axis to a power-of-2 bucket: every distinct G would
+        # otherwise be a fresh XLA compilation (SURVEY §7 hard part 3);
+        # count-0 padding rows are no-ops in the water-fill
+        Gp = max(8, 1 << (G - 1).bit_length())
+        req_arr = np.zeros((Gp, width), dtype=np.int32)
+        req_arr[:G] = np.stack(reqs)
+        cnt_arr = np.zeros(Gp, dtype=np.int32)
+        cnt_arr[:G] = counts
+        counts_dev, _ = schedule_grouped(
+            jnp.asarray(totals), jnp.asarray(avail), jnp.asarray(mask),
+            jnp.asarray(req_arr), jnp.asarray(cnt_arr),
+            jnp.ones((Gp, N), dtype=bool), jnp.int32(threshold_fp(None)))
+        counts_host = np.asarray(counts_dev)[:G]
+        # expand (G, N+1) counts into per-task rows, class-internal order
+        # node-row-ascending (tasks within a class are interchangeable)
+        slots = [np.repeat(
+            np.concatenate([np.arange(N, dtype=np.int32),
+                            np.array([-1], dtype=np.int32)]),
+            counts_host[g]) for g in range(G)]
+        cursor = np.zeros(G, dtype=np.int64)
+        rows = []
+        for t in range(len(specs)):
+            g = task_group[t]
+            rows.append(int(slots[g][cursor[g]]))
+            cursor[g] += 1
+        return rows
+
+    def _effective_snapshot(self):
+        """CRM snapshot minus every node's planned-but-undispatched load,
+        so placement rounds do not over-assign nodes whose local queues
+        are already deep."""
+        snapshot = self.crm.snapshot()
+        for row, raylet in list(self.cluster.raylets.items()):
+            planned = raylet.planned_snapshot()
+            if planned is None:
+                continue
+            w = min(snapshot.avail.shape[1], planned.shape[0])
+            snapshot.avail[row, :w] = (
+                snapshot.avail[row, :w].astype(np.int64) - planned[:w]
+            ).clip(-(2**30), 2**30).astype(np.int32)
+        return snapshot
+
+    def _options_for(self, spec) -> SchedulingOptions:
+        kind = spec.strategy.kind
+        if kind is SchedulingStrategyKind.SPREAD:
+            return SchedulingOptions(scheduling_type=SchedulingType.SPREAD)
+        if kind is SchedulingStrategyKind.NODE_AFFINITY:
+            row = self.crm.row_of(spec.strategy.node_id)
+            return SchedulingOptions(
+                scheduling_type=SchedulingType.NODE_AFFINITY,
+                node_row=row if row is not None else -1,
+                soft=spec.strategy.soft)
+        return SchedulingOptions()
+
+    def _place_batch(self, batch: list[TaskID]) -> list[TaskID]:
+        """Assign every task a node (ONE scheduling decision per task);
+        returns the infeasible leftover."""
+        recs = []
+        for task_id in batch:
+            rec = self.task_manager.get(task_id)
+            if rec is not None and not rec.done:
+                recs.append(rec)
+        if not recs:
+            return []
+        rows = self._schedule_rows(recs)
         leftover: list[TaskID] = []
-        for i, task_id in enumerate(batch):
+        for rec, row in zip(recs, rows):
+            if row < 0:
+                leftover.append(rec.spec.task_id)
+            elif row == self.row:
+                self.enqueue_local(rec.spec.task_id)
+            elif not self.cluster.route_local(row, rec.spec.task_id):
+                leftover.append(rec.spec.task_id)   # target died: retry
+        return leftover
+
+    def _drain_local(self) -> None:
+        """Dispatch placed tasks to workers; stops scanning after a run of
+        consecutive failures (worker/resource-starved queue parks until the
+        next idle/free event — no O(n^2) rescans)."""
+        max_misses = 8
+        misses = 0
+        scanned = 0
+        failed_classes: set = set()     # resource classes that cannot fit
+        while misses < max_misses:
+            with self._cv:
+                if scanned >= len(self._local_queue):
+                    return
+                task_id = self._local_queue[scanned]
             rec = self.task_manager.get(task_id)
             if rec is None or rec.done:
+                with self._cv:
+                    try:
+                        self._local_queue.remove(task_id)
+                    except ValueError:
+                        continue            # concurrent cancel removed it
+                    if rec is not None:
+                        self._planned_add(rec.spec.resources, -1)
                 continue
             spec = rec.spec
-            # reserve resources BEFORE popping a worker: pool.release fires
-            # the idle wake-up, so a speculative pop-then-release of the
-            # same worker would spin the loop on an unplaceable backlog
+            if spec.resources.key() in failed_classes:
+                scanned += 1
+                continue
+            # reserve resources BEFORE popping a worker (pool.release
+            # fires the idle wake-up, so a speculative pop-then-release
+            # would spin the loop)
             if not self.crm.subtract(self.row, spec.resources):
-                leftover.append(task_id)
+                failed_classes.add(spec.resources.key())
+                misses += 1
+                scanned += 1
                 continue
             worker = self.pool.pop_idle()
             if worker is None:
                 self.crm.add_back(self.row, spec.resources)
-                leftover.append(task_id)
-                leftover.extend(batch[i + 1:])
-                break
-            if not self._dispatch(worker, rec):
-                # dep error or send failure; resources already returned
-                continue
-        return leftover
+                return                      # worker-limited: park
+            with self._cv:
+                try:
+                    self._local_queue.remove(task_id)
+                except ValueError:
+                    self.crm.add_back(self.row, spec.resources)
+                    self.pool.release(worker)
+                    continue
+                self._planned_add(spec.resources, -1)
+            self._dispatch(worker, rec)
 
     def _dispatch(self, worker: WorkerHandle, rec) -> bool:
         spec = rec.spec
@@ -165,7 +377,13 @@ class Raylet:
 
         fn_id = spec.function_descriptor
         if fn_id not in worker.fn_cache:
-            if not worker.send(("fn", fn_id, self._fn_registry[fn_id])):
+            fn_bytes = self._fn_registry.get(fn_id)
+            if fn_bytes is None:
+                self._finish_with_error(rec, RayTaskError(
+                    fn_id, "function bytes never reached the driver "
+                    "(stub submitted without registration)"), worker)
+                return False
+            if not worker.send(("fn", fn_id, fn_bytes)):
                 self._requeue_after_worker_loss(rec, worker)
                 return False
             worker.fn_cache.add(fn_id)
@@ -204,10 +422,11 @@ class Raylet:
                 return
             if kind == "actor_create":
                 from ..common.ids import ActorID
-                args, kwargs, max_restarts, max_task_retries, name = \
+                args, kwargs, max_restarts, max_task_retries, name, res = \
                     deserialize(msg[4])
                 am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
-                                kwargs, max_restarts, max_task_retries, name)
+                                kwargs, max_restarts, max_task_retries,
+                                name, resources=res)
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
@@ -336,8 +555,30 @@ class Raylet:
     def cancel(self, task_id: TaskID, force: bool = False) -> bool:
         from .serialization import TaskCancelledError
         with self._cv:
+            if task_id in self._local_queue:
+                rec0 = self.task_manager.get(task_id)
+                self._local_queue.remove(task_id)
+                if rec0 is not None:
+                    self._planned_add(rec0.spec.resources, -1)
+                rec = self.task_manager.complete(task_id)
+                if rec:
+                    err = RayTaskError(rec.spec.function_descriptor,
+                                       "cancelled", TaskCancelledError())
+                    for oid in rec.return_ids:
+                        self.store.put(oid, err)
+                return True
             if task_id in self._queue:
                 self._queue.remove(task_id)
+                rec = self.task_manager.complete(task_id)
+                if rec:
+                    err = RayTaskError(rec.spec.function_descriptor,
+                                       "cancelled", TaskCancelledError())
+                    for oid in rec.return_ids:
+                        self.store.put(oid, err)
+                return True
+            if self._waiting.pop(task_id, None) is not None:
+                # dep-waiting: resolve its refs with the cancellation error
+                # (a later _dep_ready finds no entry and is a no-op)
                 rec = self.task_manager.complete(task_id)
                 if rec:
                     err = RayTaskError(rec.spec.function_descriptor,
@@ -351,6 +592,38 @@ class Raylet:
             self.pool.kill_worker(worker)   # death path handles bookkeeping
             return True
         return False
+
+    def drain_for_removal(self, fallback: "Raylet") -> None:
+        """Node death: fail/retry running tasks, reroute queued ones,
+        restart-or-fail actors placed here, keep dep-waiting tasks alive
+        (their readiness callbacks re-route to the fallback raylet)."""
+        with self._cv:
+            self._stopped = True
+            self._removal_fallback = fallback
+            queued = list(self._queue) + list(self._local_queue)
+            self._queue.clear()
+            self._local_queue.clear()
+            running = list(self._running.items())
+            self._running.clear()
+            self._cv.notify_all()
+        if self.actor_manager is not None:
+            self.actor_manager.fail_actors_on_pool(self.pool)
+        for task_id in queued:
+            fallback.enqueue_forwarded(task_id)
+        for _bin, (task_id, _w) in running:
+            if self.task_manager.should_retry(task_id):
+                fallback.enqueue_forwarded(task_id)
+            else:
+                rec = self.task_manager.get(task_id)
+                if rec is None:
+                    continue
+                self.task_manager.complete(task_id)
+                err = RayTaskError(
+                    rec.spec.function_descriptor, "node removed",
+                    WorkerCrashedError("node died"))
+                for oid in rec.return_ids:
+                    self.store.put(oid, err)
+        self.pool.shutdown()
 
     def stop(self) -> None:
         with self._cv:

@@ -101,11 +101,11 @@ class WorkerApiContext:
     # -- actor API (frames handled by the driver's ActorManager) ------------
     def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
                      args, kwargs, max_restarts: int, max_task_retries: int,
-                     name: str | None):
+                     name: str | None, resources=None):
         self._conn.send(("actor_create", actor_id.binary(), cls_id,
                          cls_bytes, serialize(
                              (args, kwargs, max_restarts, max_task_retries,
-                              name))))
+                              name, resources))))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
                           kwargs, num_returns: int):

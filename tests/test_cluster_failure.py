@@ -1,0 +1,33 @@
+"""Node-death recovery on the simulated cluster (own module: needs a
+fresh runtime, and test_cluster.py holds a module-scoped one)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+class TestNodeFailure:
+    def test_remove_node_retries_elsewhere(self):
+        c = Cluster()
+        c.add_node(resources={"CPU": 2, "memory": 2}, num_workers=2)
+        doomed = c.add_node(resources={"CPU": 2, "memory": 2},
+                            num_workers=2)
+        ray_tpu.init(cluster=c)
+        try:
+            @ray_tpu.remote(max_retries=2)
+            def slowish(x):
+                time.sleep(0.4)
+                return x * 2
+
+            refs = [slowish.remote(i) for i in range(8)]
+            time.sleep(0.1)
+            c.remove_node(doomed)
+            assert ray_tpu.get(refs, timeout=60) == \
+                [i * 2 for i in range(8)]
+            assert len(ray_tpu.nodes()) == 1
+        finally:
+            ray_tpu.shutdown()
+            c.stop()
