@@ -1,0 +1,202 @@
+"""Placement-group lifecycle: gang placement + 2-phase reservation.
+
+Reference parity: ``GcsPlacementGroupManager`` + ``GcsPlacementGroupScheduler
+::ScheduleUnplacedBundles`` with 2-phase commit (PrepareBundleResources on
+each raylet -> all-ack -> CommitBundleResources, any nack -> rollback) and
+the committed-bundle resource shaping (``CPU_group_{pgid}`` /
+``CPU_group_{i}_{pgid}`` custom resources that pg tasks request) —
+``src/ray/gcs/gcs_server/gcs_placement_group_*``, SURVEY.md §3.5; mount
+empty.
+
+Placement itself is the bundle policy contract from
+``ray_tpu/scheduling/bundles.py`` (device twin: ``ops.bundle_kernel``).
+Groups that cannot place now go to a pending list retried on every resource
+release / node arrival (event-driven via the CRM version, polled by a slow
+ticker as a safety net).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..common.ids import ObjectID, PlacementGroupID, TaskID
+from ..common.resources import ResourceRequest, from_cu
+from ..scheduling.bundles import PlacementStrategy, schedule_bundles
+from .object_ref import ObjectRef
+
+
+def shaped_name(base: str, pg_hex: str, bundle_index: int | None = None
+                ) -> str:
+    if bundle_index is None:
+        return f"{base}_group_{pg_hex}"
+    return f"{base}_group_{bundle_index}_{pg_hex}"
+
+
+def shape_request(resources: dict[str, float], pg_hex: str,
+                  bundle_index: int = -1) -> dict[str, float]:
+    """Rewrite a task's demand onto pg-shaped resources (reference: tasks
+    under a PlacementGroupSchedulingStrategy consume ``*_group_*``)."""
+    idx = None if bundle_index < 0 else bundle_index
+    return {shaped_name(k, pg_hex, idx): v for k, v in resources.items()}
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: PlacementGroupID
+    bundles: list[dict[str, float]]
+    strategy: PlacementStrategy
+    name: str | None
+    state: str = "PENDING"              # PENDING | CREATED | REMOVED
+    rows: list[int] = field(default_factory=list)
+    ready_oid: ObjectID | None = None
+
+
+class PlacementGroupManager:
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._crm = cluster.crm
+        self._store = cluster.store
+        self._lock = threading.RLock()
+        self._groups: dict[PlacementGroupID, PlacementGroupRecord] = {}
+        self._pending: list[PlacementGroupID] = []
+        self._ticker: threading.Thread | None = None
+        self._stop = False
+
+    # -- creation -----------------------------------------------------------
+    def create(self, pg_id: PlacementGroupID,
+               bundles: list[dict[str, float]], strategy: PlacementStrategy,
+               name: str | None = None) -> ObjectID:
+        ready_oid = ObjectID.for_task_return(
+            TaskID.deterministic(pg_id.binary(), _nil_actor()), 1)
+        rec = PlacementGroupRecord(pg_id, [dict(b) for b in bundles],
+                                   strategy, name, ready_oid=ready_oid)
+        with self._lock:
+            self._groups[pg_id] = rec
+            if not self._try_place(rec):
+                self._pending.append(pg_id)
+                self._ensure_ticker()
+        return ready_oid
+
+    def _try_place(self, rec: PlacementGroupRecord) -> bool:
+        """Place + 2-phase reserve. Caller holds the lock."""
+        reqs = [ResourceRequest(b) for b in rec.bundles]
+        width = self._crm.avail.shape[1]
+        for r in reqs:                      # intern any new resource names
+            self._crm._dense_req(r)
+        width = self._crm.avail.shape[1]
+        dense = np.stack([r.dense(self._crm.resource_index, width)
+                          for r in reqs])
+        snapshot = self._crm.snapshot()
+        rows = schedule_bundles(snapshot, dense, rec.strategy, commit=False)
+        if rows is None:
+            return False
+        # phase 1 — prepare: reserve base resources on each chosen raylet
+        prepared: list[tuple[int, ResourceRequest]] = []
+        ok = True
+        for b, row in enumerate(rows):
+            if self._crm.subtract(int(row), reqs[b]):
+                prepared.append((int(row), reqs[b]))
+            else:                           # raced with a task: rollback
+                ok = False
+                break
+        if not ok:
+            for row, r in prepared:
+                self._crm.add_back(row, r)
+            return False
+        # phase 2 — commit: surface the shaped bundle resources
+        pg_hex = rec.pg_id.hex()
+        for b, row in enumerate(rows):
+            shaped: dict[str, int] = {}
+            for kname, cu in reqs[b].cu().items():
+                shaped[shaped_name(kname, pg_hex, b)] = cu
+                shaped[shaped_name(kname, pg_hex)] = cu
+            self._crm.add_shaped_resources(int(row), shaped)
+        rec.rows = [int(r) for r in rows]
+        rec.state = "CREATED"
+        self._store.put(rec.ready_oid, {
+            "placement_group_id": pg_hex,
+            "bundles_to_node_row": rec.rows,
+        })
+        self._wake_raylets()
+        return True
+
+    def _wake_raylets(self) -> None:
+        for raylet in list(self._cluster.raylets.values()):
+            raylet._notify_dirty()
+
+    # -- pending retry ------------------------------------------------------
+    def _ensure_ticker(self) -> None:
+        if self._ticker is None or not self._ticker.is_alive():
+            self._ticker = threading.Thread(
+                target=self._retry_loop, daemon=True, name="pg-pending")
+            self._ticker.start()
+
+    def _retry_loop(self) -> None:
+        last_version = -1
+        while not self._stop:
+            with self._lock:
+                if not self._pending:
+                    return
+                if self._crm.version != last_version:
+                    last_version = self._crm.version
+                    still = []
+                    for pg_id in self._pending:
+                        rec = self._groups.get(pg_id)
+                        if rec is None or rec.state != "PENDING":
+                            continue
+                        if not self._try_place(rec):
+                            still.append(pg_id)
+                    self._pending = still
+            time.sleep(0.05)
+
+    # -- removal ------------------------------------------------------------
+    def remove(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            rec = self._groups.get(pg_id)
+            if rec is None or rec.state == "REMOVED":
+                return
+            if rec.state == "PENDING":
+                rec.state = "REMOVED"
+                if pg_id in self._pending:
+                    self._pending.remove(pg_id)
+                return
+            pg_hex = pg_id.hex()
+            for b, row in enumerate(rec.rows):
+                req = ResourceRequest(rec.bundles[b])
+                shaped: dict[str, int] = {}
+                for kname, cu in req.cu().items():
+                    shaped[shaped_name(kname, pg_hex, b)] = cu
+                    shaped[shaped_name(kname, pg_hex)] = cu
+                self._crm.remove_shaped_resources(row, shaped)
+                self._crm.add_back(row, req)
+            rec.state = "REMOVED"
+        self._wake_raylets()
+
+    # -- introspection ------------------------------------------------------
+    def table(self) -> dict:
+        with self._lock:
+            return {
+                rec.pg_id.hex(): {
+                    "state": rec.state,
+                    "name": rec.name,
+                    "strategy": rec.strategy.name,
+                    "bundles": [dict(b) for b in rec.bundles],
+                    "node_rows": list(rec.rows),
+                } for rec in self._groups.values()
+            }
+
+    def get(self, pg_id: PlacementGroupID) -> PlacementGroupRecord | None:
+        with self._lock:
+            return self._groups.get(pg_id)
+
+    def shutdown(self) -> None:
+        self._stop = True
+
+
+def _nil_actor():
+    from ..common.ids import ActorID, JobID
+    return ActorID.nil_for_job(JobID.from_int(0))

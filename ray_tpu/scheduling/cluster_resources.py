@@ -152,6 +152,28 @@ class ClusterResourceManager:
                                          self.avail[row] + vec)
             self.version += 1
 
+    # -- bundle (placement-group) resource shaping --------------------------
+    def add_shaped_resources(self, row: int, shaped_cu: dict[str, int]
+                             ) -> None:
+        """Create/extend pg-shaped resource columns on a node (reference:
+        committed bundles surface as ``CPU_group_{pgid}``-style custom
+        resources that pg tasks then request — SURVEY §3.5)."""
+        with self._lock:
+            for name, cu in shaped_cu.items():
+                col = self._col(name)
+                self.totals[row, col] += cu
+                self.avail[row, col] += cu
+            self.version += 1
+
+    def remove_shaped_resources(self, row: int, shaped_cu: dict[str, int]
+                                ) -> None:
+        with self._lock:
+            for name, cu in shaped_cu.items():
+                col = self._col(name)
+                self.totals[row, col] = max(0, self.totals[row, col] - cu)
+                self.avail[row, col] = max(0, self.avail[row, col] - cu)
+            self.version += 1
+
     # -- views --------------------------------------------------------------
     def snapshot(self) -> ClusterState:
         """Copy-on-read snapshot for a scheduling round (pure-function
