@@ -27,8 +27,10 @@ def __getattr__(name):
         from . import api
         return getattr(api, name)
     if name == "util":
-        from . import util
-        return util
+        # NOT `from . import util`: that re-enters __getattr__ via the
+        # fromlist hasattr probe before the submodule import finishes
+        import importlib
+        return importlib.import_module(".util", __name__)
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
 
 

@@ -54,6 +54,13 @@ class Cluster:
         raylet.start()
         if wait and num_workers:
             raylet.pool.wait_ready(num_workers, timeout=60.0)
+        # wake every existing raylet: tasks parked as infeasible may now
+        # have a feasible node (reference: node arrival triggers a
+        # scheduling round on every raylet via the resource broadcast)
+        with self._lock:
+            others = [r for r in self.raylets.values() if r is not raylet]
+        for r in others:
+            r._notify_dirty()
         return node_id
 
     def remove_node(self, node_id: NodeID) -> None:

@@ -241,10 +241,19 @@ class ActorManager:
         kind = msg[0]
         if kind == "actor_ready":
             actor_id = ActorID(msg[1])
+            doomed = None       # (pool, worker) of an actor killed mid-ctor
             with self._lock:
                 rec = self._actors.get(actor_id)
                 if rec is not None:
-                    rec.state = ActorState.ALIVE
+                    if rec.state is ActorState.DEAD:
+                        # killed while PENDING: do not resurrect — reap the
+                        # dedicated worker and return its resources
+                        doomed = self._reap_worker_locked(rec)
+                    else:
+                        rec.state = ActorState.ALIVE
+            if doomed is not None:
+                self._kill_reaped(doomed)
+                return True
             self._pump(actor_id)
             return True
         if kind == "actor_init_error":
@@ -289,6 +298,10 @@ class ActorManager:
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None:
+                return True
+            if rec.state is ActorState.DEAD:
+                # already reaped (kill-mid-ctor / ctor failure): the reap
+                # path returned resources and failed the queue
                 return True
             if rec.row >= 0 and not rec.resources.is_empty():
                 self._cluster.crm.add_back(rec.row, rec.resources)
@@ -340,6 +353,24 @@ class ActorManager:
             rec.state = ActorState.PENDING
         self._start_incarnation(rec)
 
+    def _reap_worker_locked(self, rec: ActorRecord):
+        """Detach the record's dedicated worker and return its reserved
+        resources to the CRM.  Caller holds the lock; returns the
+        (pool, worker) pair for the caller to kill outside the lock (or
+        None if there is no worker)."""
+        pool, worker = rec.pool, rec.worker
+        rec.worker = None
+        if worker is not None and rec.row >= 0 \
+                and not rec.resources.is_empty():
+            self._cluster.crm.add_back(rec.row, rec.resources)
+            rec.row = -1
+        return (pool, worker) if worker is not None else None
+
+    def _kill_reaped(self, doomed) -> None:
+        pool, worker = doomed
+        if pool is not None and worker is not None:
+            pool.kill_worker(worker)
+
     def _on_incarnation_dead(self, actor_id: ActorID,
                              init_error=None) -> None:
         with self._lock:
@@ -347,10 +378,16 @@ class ActorManager:
             if rec is None:
                 return
             rec.state = ActorState.DEAD
+            # ctor failed (or never got a node): reap the dedicated worker
+            # and return reserved resources, else repeated failing actors
+            # exhaust the node and leak processes
+            doomed = self._reap_worker_locked(rec)
             queued = list(rec.queue)
             rec.queue.clear()
             if rec.name is not None:
                 self._names.pop(rec.name, None)
+        if doomed is not None:
+            self._kill_reaped(doomed)
         err = init_error if init_error is not None else RayTaskError(
             "actor ctor", "actor failed to start", ActorDiedError())
         for call in queued:
@@ -367,11 +404,15 @@ class ActorManager:
             if no_restart:
                 rec.restarts_left = 0
             worker = rec.worker if rec.state is ActorState.ALIVE else None
-            # PENDING (deps unresolved / worker starting) or RESTARTING:
-            # there is no live worker to kill — mark dead directly so the
-            # deferred _start/_restart_incarnation bails out
+            # PENDING (ctor running / deps unresolved) or RESTARTING: mark
+            # dead so the deferred _start/_restart_incarnation (or the
+            # in-flight actor_ready) bails out; if a dedicated worker was
+            # already spawned for the ctor, reap it too — otherwise the
+            # process and its reserved resources leak
             if no_restart and rec.state in (ActorState.PENDING,
                                             ActorState.RESTARTING):
+                doomed = self._reap_worker_locked(rec)
+                worker = doomed[1] if doomed is not None else None
                 self._mark_dead_locked(rec)
         if worker is not None:
             pool = rec.pool if rec.pool is not None \

@@ -84,9 +84,8 @@ class PlacementGroupManager:
     def _try_place(self, rec: PlacementGroupRecord) -> bool:
         """Place + 2-phase reserve. Caller holds the lock."""
         reqs = [ResourceRequest(b) for b in rec.bundles]
-        width = self._crm.avail.shape[1]
         for r in reqs:                      # intern any new resource names
-            self._crm._dense_req(r)
+            self._crm.intern_request(r)     # (lock-acquiring: grows arrays)
         width = self._crm.avail.shape[1]
         dense = np.stack([r.dense(self._crm.resource_index, width)
                           for r in reqs])

@@ -194,14 +194,24 @@ class Raylet:
         if cfg.scheduler_device_backend and uniform and \
                 len(batch) >= cfg.scheduler_device_batch_min:
             return self._schedule_rows_device(specs)
-        # per-task CPU policy on a snapshot (sequential within the round)
+        # per-task CPU policy on a snapshot (sequential within the round),
+        # partitioned by scheduling class in first-appearance order — the
+        # same order the device path's contract uses, so both backends
+        # evolve `avail` identically and the scheduler_device_batch_min
+        # threshold is not observable in placements
         snapshot = self._effective_snapshot()
-        rows = []
-        for spec in specs:
-            req = spec.resources.dense(self.crm.resource_index,
-                                       snapshot.totals.shape[1])
-            rows.append(self._policy.schedule(
-                snapshot, req, self._options_for(spec)))
+        by_class: dict[tuple, list[int]] = {}
+        for t, spec in enumerate(specs):
+            by_class.setdefault(spec.scheduling_class(), []).append(t)
+        rows = [-1] * len(specs)
+        for idxs in by_class.values():
+            # one dense vector per class (identical by definition), as the
+            # device path does
+            req = specs[idxs[0]].resources.dense(
+                self.crm.resource_index, snapshot.totals.shape[1])
+            for t in idxs:
+                rows[t] = self._policy.schedule(
+                    snapshot, req, self._options_for(specs[t]))
         return rows
 
     def _schedule_rows_device(self, specs: list) -> list[int]:
@@ -474,28 +484,28 @@ class Raylet:
             # waits (reference: CPU is returned during ray.get so dependent
             # tasks can run) and grow the pool if it is starved — otherwise
             # recursive fan-out deadlocks on worker slots.
-            rec = None
-            if worker.leased_task is not None:
-                with self._cv:
-                    entry = self._running.get(worker.leased_task)
-                if entry is not None:
-                    rec = self.task_manager.get(entry[0])
-            worker.blocked = True
-            if rec is not None:
-                self.crm.add_back(self.row, rec.spec.resources)
-                self._notify_dirty()
-            self.pool.grow_for_blocked()
+            rec = self._rec_of_worker(worker)
+            self._enter_blocked(worker, rec)
             values = self.store.get_raw_blocking(oids, timeout=timeout)
-            # re-acquire before resuming (waits for capacity like the
-            # reference's worker unblock path; bounded oversubscription is
-            # preferred over a stuck reader if capacity never frees)
-            if rec is not None:
-                self._reacquire(rec.spec.resources)
-            worker.blocked = False
+            self._exit_blocked(worker, rec)
             if values is None:
                 worker.send(("get_reply", serialize(("timeout", None))))
             else:
                 worker.send(("get_reply", serialize(("ok", values))))
+        elif kind == "wait":
+            oids = [self._oid(b) for b in msg[1]]
+            num_returns = min(msg[2], len(oids))
+            timeout = msg[3]
+            # fast path: already satisfiable without blocking this reader
+            ready, _ = self.store.wait(oids, num_returns, timeout=0)
+            if len(ready) < num_returns and (timeout is None or timeout > 0):
+                rec = self._rec_of_worker(worker)
+                self._enter_blocked(worker, rec)
+                ready, _ = self.store.wait(oids, num_returns,
+                                           timeout=timeout)
+                self._exit_blocked(worker, rec)
+            worker.send(("wait_reply",
+                         serialize([o.binary() for o in ready])))
         elif kind == "put":
             self.store.put(self._oid(msg[1]), deserialize(msg[2]))
         elif kind == "submit":
@@ -509,6 +519,31 @@ class Raylet:
     def _oid(binary: bytes):
         from ..common.ids import ObjectID
         return ObjectID(binary)
+
+    def _rec_of_worker(self, worker: WorkerHandle):
+        """TaskRecord of the task the worker is currently executing."""
+        if worker.leased_task is None:
+            return None
+        with self._cv:
+            entry = self._running.get(worker.leased_task)
+        return self.task_manager.get(entry[0]) if entry is not None else None
+
+    def _enter_blocked(self, worker: WorkerHandle, rec) -> None:
+        """Worker blocks in get/wait: return its task's resources so
+        dependent tasks can run, and grow the pool if starved."""
+        worker.blocked = True
+        if rec is not None:
+            self.crm.add_back(self.row, rec.spec.resources)
+            self._notify_dirty()
+        self.pool.grow_for_blocked()
+
+    def _exit_blocked(self, worker: WorkerHandle, rec) -> None:
+        """Re-acquire before resuming (waits for capacity like the
+        reference's worker unblock path; bounded oversubscription is
+        preferred over a stuck reader if capacity never frees)."""
+        if rec is not None:
+            self._reacquire(rec.spec.resources)
+        worker.blocked = False
 
     def _reacquire(self, resources: ResourceRequest,
                    patience: float = 5.0) -> None:

@@ -12,9 +12,11 @@ send/recv pairs are race-free without correlation ids.
 
 Frames (tuples, first element is the kind):
   raylet -> worker: ("fn", fn_id, bytes), ("exec", task_id_bin, fn_id,
-                    payload), ("get_reply", payload), ("shutdown",)
+                    payload), ("get_reply", payload),
+                    ("wait_reply", payload), ("shutdown",)
   worker -> raylet: ("ready",), ("result", task_id_bin, [bytes, ...]),
                     ("error", task_id_bin, bytes), ("get", [oid_bin, ...]),
+                    ("wait", [oid_bin, ...], num_returns, timeout),
                     ("put", oid_bin, bytes), ("submit", spec_bytes,
                     fn_id, fn_bytes | None)
 """
@@ -89,11 +91,15 @@ class WorkerApiContext:
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):
-        # worker-side wait degrades to a full get of the first num_returns
-        # (v1: no partial-wait RPC; the raylet-side store answers gets)
-        ready = refs[:num_returns]
-        self.get(ready, timeout)
-        return ready, refs[num_returns:]
+        """True ray.wait semantics: the raylet-side store partitions by
+        actual readiness; partial (ready, not_ready) on timeout, no raise."""
+        self._conn.send(("wait", [r.binary() for r in refs], num_returns,
+                         timeout))
+        _, payload = self._recv_reply("wait_reply")
+        ready_bins = set(deserialize(payload))
+        ready = [r for r in refs if r.binary() in ready_bins]
+        not_ready = [r for r in refs if r.binary() not in ready_bins]
+        return ready, not_ready
 
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
         self._conn.send(("submit", serialize(spec), fn_id, fn_bytes))

@@ -112,10 +112,17 @@ class ClusterResourceManager:
 
     def _dense_req(self, req: ResourceRequest) -> np.ndarray:
         """Dense cu vector, growing the resource slots to cover the request
-        (ResourceRequest.dense interns names but cannot grow our arrays)."""
+        (ResourceRequest.dense interns names but cannot grow our arrays).
+        Caller must hold self._lock (array growth replaces the arrays)."""
         for name in req.cu():
             self._col(name)
         return req.dense(self.resource_index, self._r_slots)
+
+    def intern_request(self, req: ResourceRequest) -> np.ndarray:
+        """Public, lock-acquiring name interning + densification — the safe
+        entry point for external callers (array growth under _lock)."""
+        with self._lock:
+            return self._dense_req(req)
 
     # -- sync from heartbeats (ray_syncer analogue, SURVEY §2.1) ------------
     def update_node_available(self, node_id: NodeID,

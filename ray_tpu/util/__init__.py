@@ -1,0 +1,12 @@
+"""ray_tpu.util — utility namespace.
+
+Reference parity: upstream ``ray.util`` hosts ``placement_group``, the
+state API, and user metrics (``python/ray/util/`` — SURVEY.md §1 layers
+9/12; mount empty).  Populated incrementally; importing the package must
+always succeed because ``ray_tpu.__getattr__`` resolves ``ray_tpu.util``
+lazily.
+"""
+
+from __future__ import annotations
+
+__all__: list[str] = []

@@ -212,3 +212,54 @@ class TestActors:
         b = Bad.remote()
         with pytest.raises(Exception):
             ray_tpu.get(b.m.remote(), timeout=20)
+
+    def test_kill_during_ctor_not_resurrected(self, rt):
+        """kill() while the constructor is running must not be undone by
+        the actor_ready frame when the ctor finishes."""
+        @ray_tpu.remote
+        class SlowCtor:
+            def __init__(self):
+                time.sleep(1.0)
+
+            def ping(self):
+                return "pong"
+
+        h = SlowCtor.remote()
+        time.sleep(0.3)                  # ctor is running on its worker
+        ray_tpu.kill(h)
+        time.sleep(1.5)                  # let actor_ready arrive post-kill
+        from ray_tpu import api
+        state = api._get_runtime().actor_manager.state_of(h._actor_id)
+        assert state is not None and state.name == "DEAD"
+        with pytest.raises(Exception):
+            ray_tpu.get(h.ping.remote(), timeout=20)
+
+    def test_ctor_failure_returns_resources_and_reaps_worker(self, rt):
+        """A failing constructor must return the actor's reserved
+        resources and kill the dedicated worker (repeated failures must
+        not exhaust the node or leak processes)."""
+        from ray_tpu import api
+        crm = api._get_runtime().crm
+        before = crm.snapshot().avail.sum()
+
+        @ray_tpu.remote
+        class Boom:
+            def __init__(self):
+                raise ValueError("ctor boom")
+
+            def m(self):
+                return 1
+
+        handles = [Boom.options(resources={"CPU": 1}).remote()
+                   for _ in range(3)]
+        for h in handles:
+            with pytest.raises(Exception):
+                ray_tpu.get(h.m.remote(), timeout=20)
+        # leak = avail permanently BELOW the starting level; other tests'
+        # tasks finishing concurrently can only raise it
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if crm.snapshot().avail.sum() >= before:
+                break
+            time.sleep(0.1)
+        assert crm.snapshot().avail.sum() >= before

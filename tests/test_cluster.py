@@ -99,3 +99,27 @@ class TestMultiNode:
             assert ray_tpu.get(refs, timeout=60) == [i + 1 for i in range(64)]
         finally:
             cfg.scheduler_device_batch_min = old
+
+
+class TestNodeArrival:
+    def test_add_node_wakes_parked_infeasible_tasks(self):
+        """A task parked as infeasible must run once a node with the
+        required resource joins (reference: node arrival triggers
+        rescheduling on every raylet)."""
+        c = Cluster()
+        c.add_node(resources={"CPU": 2, "memory": 2}, num_workers=2)
+        ray_tpu.shutdown()
+        ray_tpu.init(cluster=c)
+        try:
+            @ray_tpu.remote(resources={"GPU": 1})
+            def needs_gpu():
+                return "ran"
+
+            ref = needs_gpu.remote()
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+            assert not ready                     # parked: no GPU anywhere
+            c.add_node(resources={"CPU": 1, "GPU": 1}, num_workers=1)
+            assert ray_tpu.get(ref, timeout=30) == "ran"
+        finally:
+            ray_tpu.shutdown()
+            c.stop()

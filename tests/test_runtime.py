@@ -152,3 +152,39 @@ class TestBasics:
         ray_tpu.get(refs)
         elapsed = time.time() - t0
         assert elapsed < 1.5, f"4x0.5s tasks on 4 workers took {elapsed}"
+
+
+class TestWorkerSideWait:
+    def test_wait_inside_task_ready_first_semantics(self, rt):
+        """ray.wait inside a task must return whichever refs are ready
+        first (not the first num_returns in list order), and must return
+        partial lists on timeout without raising."""
+        @ray_tpu.remote
+        def slow():
+            time.sleep(30)
+            return "slow"
+
+        @ray_tpu.remote
+        def fast():
+            return "fast"
+
+        @ray_tpu.remote
+        def prober(slow_ref, fast_ref):
+            # pass refs inside a list so they are not pre-resolved as args
+            ready, not_ready = ray_tpu.wait(
+                [slow_ref[0], fast_ref[0]], num_returns=1, timeout=10)
+            out = ["ready" if r is not None else "?" for r in ready]
+            assert len(ready) == 1 and len(not_ready) == 1
+            # the ready one must be the fast ref (second in list order)
+            assert ready[0].binary() == fast_ref[0].binary()
+            # timeout path: ask for both within a tiny window -> partial
+            r2, nr2 = ray_tpu.wait(
+                [slow_ref[0], fast_ref[0]], num_returns=2, timeout=0.2)
+            assert len(r2) == 1 and len(nr2) == 1
+            return "ok"
+
+        s = slow.remote()
+        f = fast.remote()
+        time.sleep(0.5)                 # let fast finish, slow still running
+        assert ray_tpu.get(prober.remote([s], [f]), timeout=30) == "ok"
+        ray_tpu.cancel(s, force=True)
