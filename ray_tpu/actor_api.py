@@ -128,6 +128,16 @@ class ActorClass:
             res["GPU"] = opts["num_gpus"]
         # default: actors hold no resources while alive (reference default
         # is num_cpus=0 for an actor's lifetime)
+        from .api import _resolve_strategy_options
+        from .common.task_spec import (DEFAULT_STRATEGY,
+                                       SchedulingStrategyKind)
+        strategy = _resolve_strategy_options(
+            opts.get("scheduling_strategy"), opts.get("placement_group"),
+            opts.get("placement_group_bundle_index", -1), DEFAULT_STRATEGY)
+        if strategy.kind is SchedulingStrategyKind.PLACEMENT_GROUP:
+            from .runtime.placement_group_manager import shape_request
+            res = shape_request(res, strategy.placement_group_id.hex(),
+                                strategy.bundle_index)
         resources = ResourceRequest(res)
         cls_id, cls_bytes = self._materialize()
         if rt.is_driver:
@@ -137,7 +147,8 @@ class ActorClass:
             job_id = cur.job_id() if cur else JobID.from_int(0)
             actor_id = ActorID.of(job_id)
         rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
-                        max_restarts, max_task_retries, name, resources)
+                        max_restarts, max_task_retries, name, resources,
+                        strategy)
         return ActorHandle(actor_id)
 
 

@@ -96,11 +96,12 @@ class DriverRuntime:
 
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
-                     resources=None) -> None:
+                     resources=None, strategy=None) -> None:
         self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
                                         kwargs, max_restarts,
                                         max_task_retries, name,
-                                        resources=resources)
+                                        resources=resources,
+                                        strategy=strategy)
 
     def shutdown(self) -> None:
         # an adopted (caller-owned) cluster stays up across shutdown, the
@@ -125,7 +126,8 @@ class RemoteFunction:
     def __init__(self, fn: Callable | None, fn_bytes: bytes | None = None,
                  name: str | None = None, num_returns: int = 1,
                  resources: dict[str, float] | None = None,
-                 max_retries: int | None = None, fn_id: str | None = None):
+                 max_retries: int | None = None, fn_id: str | None = None,
+                 strategy=None):
         if fn is None and fn_bytes is None and fn_id is None:
             raise ValueError("need a function, its bytes, or its id")
         self._fn = fn
@@ -134,6 +136,7 @@ class RemoteFunction:
         self._num_returns = num_returns
         self._resources = dict(resources) if resources else {"CPU": 1}
         self._max_retries = max_retries
+        self._strategy = strategy or DEFAULT_STRATEGY
         # The id is decoration-time random, NOT a content hash: a recursive
         # remote function's bytes contain its own wrapper, whose pickle
         # embeds the id — a content hash would be circular (reference keys
@@ -144,17 +147,24 @@ class RemoteFunction:
     def options(self, *, num_returns: int | None = None,
                 resources: dict[str, float] | None = None,
                 num_cpus: float | None = None,
-                max_retries: int | None = None) -> "RemoteFunction":
+                max_retries: int | None = None,
+                scheduling_strategy=None,
+                placement_group=None,
+                placement_group_bundle_index: int = -1) -> "RemoteFunction":
         res = dict(resources) if resources is not None \
             else dict(self._resources)
         if num_cpus is not None:
             res["CPU"] = num_cpus
+        strategy = _resolve_strategy_options(
+            scheduling_strategy, placement_group,
+            placement_group_bundle_index, self._strategy)
         return RemoteFunction(
             self._fn, self._fn_bytes, self._name,
             num_returns if num_returns is not None else self._num_returns,
             res,
             max_retries if max_retries is not None else self._max_retries,
-            fn_id=self._fn_id)     # same function => same registry entry
+            fn_id=self._fn_id,     # same function => same registry entry
+            strategy=strategy)
 
     # -- serialization (registry + shipping) --------------------------------
     def _materialize(self) -> tuple[str, bytes | None]:
@@ -181,7 +191,8 @@ class RemoteFunction:
                 self._reducing = False
         return (RemoteFunction,
                 (None, None, self._name, self._num_returns,
-                 self._resources, self._max_retries, self._fn_id))
+                 self._resources, self._max_retries, self._fn_id,
+                 self._strategy))
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -201,12 +212,21 @@ class RemoteFunction:
             cur = rt.current_task_id
             job_id = cur.job_id() if cur else JobID.from_int(0)
             task_id = TaskID.for_task(job_id)
+        from .common.task_spec import SchedulingStrategyKind
+        res = self._resources
+        if self._strategy.kind is SchedulingStrategyKind.PLACEMENT_GROUP:
+            # rewrite the demand onto the group's shaped bundle resources
+            # (reference: PG tasks consume ``CPU_group_{i}_{pgid}``)
+            from .runtime.placement_group_manager import shape_request
+            res = shape_request(res,
+                                self._strategy.placement_group_id.hex(),
+                                self._strategy.bundle_index)
         spec = TaskSpec(
             task_id=task_id, job_id=job_id, task_type=TaskType.NORMAL_TASK,
             function_descriptor=fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns,
-            resources=ResourceRequest(self._resources),
-            strategy=DEFAULT_STRATEGY, max_retries=retries)
+            resources=ResourceRequest(res),
+            strategy=self._strategy, max_retries=retries)
         rt.submit_spec(spec, fn_id, fn_bytes)
         from .common.ids import ObjectID
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
@@ -231,8 +251,39 @@ def remote(*args, **options):
             fn,
             num_returns=options.get("num_returns", 1),
             resources=_normalize_resources(options),
-            max_retries=options.get("max_retries"))
+            max_retries=options.get("max_retries"),
+            strategy=_resolve_strategy_options(
+                options.get("scheduling_strategy"),
+                options.get("placement_group"),
+                options.get("placement_group_bundle_index", -1), None))
     return wrap
+
+
+def _resolve_strategy_options(scheduling_strategy, placement_group,
+                              placement_group_bundle_index, default):
+    """options() strategy resolution: explicit scheduling_strategy wins,
+    then the placement_group= shorthand, then the inherited default."""
+    if scheduling_strategy is not None:
+        from .util.scheduling_strategies import resolve_strategy
+        return resolve_strategy(scheduling_strategy)
+    if placement_group is not None:
+        from .common.task_spec import (SchedulingStrategy,
+                                       SchedulingStrategyKind)
+        _check_bundle_index(placement_group, placement_group_bundle_index)
+        return SchedulingStrategy(
+            kind=SchedulingStrategyKind.PLACEMENT_GROUP,
+            placement_group_id=placement_group.id,
+            bundle_index=placement_group_bundle_index)
+    return default
+
+
+def _check_bundle_index(pg, index: int) -> None:
+    if index < -1:
+        raise ValueError(f"invalid placement_group_bundle_index {index}")
+    if index >= 0 and pg.bundle_specs and index >= len(pg.bundle_specs):
+        raise ValueError(
+            f"placement_group_bundle_index {index} out of range for a "
+            f"{len(pg.bundle_specs)}-bundle group")
 
 
 def _normalize_resources(options: dict) -> dict[str, float]:

@@ -20,6 +20,7 @@ import threading
 from .common.ids import NodeID
 from .common.resources import NodeResources
 from .runtime.object_store import MemoryStore
+from .runtime.placement_group_manager import PlacementGroupManager
 from .runtime.raylet import Raylet
 from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
@@ -34,6 +35,7 @@ class Cluster:
         self.fn_registry: dict[str, bytes] = {}
         self.raylets: dict[int, Raylet] = {}  # row -> raylet
         self.actor_manager = None             # attached by the runtime
+        self.pg_manager = PlacementGroupManager(self)
         self._head_row: int | None = None
 
     # -- topology -----------------------------------------------------------
@@ -73,6 +75,7 @@ class Cluster:
                 raise ValueError("cannot remove head node or unknown node")
             raylet = self.raylets.pop(row)
             self.crm.remove_node(node_id)
+        self.pg_manager.on_node_removed(row)
         raylet.drain_for_removal(self.head())
 
     def head(self) -> Raylet:
@@ -94,6 +97,7 @@ class Cluster:
 
     # -- teardown -----------------------------------------------------------
     def stop(self) -> None:
+        self.pg_manager.shutdown()
         with self._lock:
             raylets = list(self.raylets.values())
             self.raylets.clear()

@@ -27,7 +27,8 @@ from dataclasses import dataclass, field
 
 from ..common.ids import ActorID, ObjectID, TaskID
 from ..common.resources import ResourceRequest
-from ..scheduling.policy import HybridSchedulingPolicy, SchedulingOptions
+from ..common.task_spec import SchedulingStrategy, SchedulingStrategyKind
+from ..scheduling.policy import SchedulingOptions
 from .object_ref import ObjectRef
 from .serialization import (ActorDiedError, RayTaskError, deserialize,
                             serialize)
@@ -62,6 +63,8 @@ class ActorRecord:
     max_task_retries: int
     name: str | None
     resources: ResourceRequest = field(default_factory=ResourceRequest)
+    strategy: SchedulingStrategy = field(
+        default_factory=SchedulingStrategy)
     state: ActorState = ActorState.PENDING
     worker = None
     pool = None                 # worker pool of the placement node
@@ -86,12 +89,14 @@ class ActorManager:
                      cls_bytes: bytes | None, args: tuple, kwargs: dict,
                      max_restarts: int, max_task_retries: int,
                      name: str | None = None,
-                     resources: ResourceRequest | None = None) -> None:
+                     resources: ResourceRequest | None = None,
+                     strategy: SchedulingStrategy | None = None) -> None:
         if cls_bytes is not None:
             self._fn_registry.setdefault(cls_id, cls_bytes)
         rec = ActorRecord(actor_id, cls_id, args, kwargs, max_restarts,
                           max_task_retries, name,
-                          resources=resources or ResourceRequest())
+                          resources=resources or ResourceRequest(),
+                          strategy=strategy or SchedulingStrategy())
         rec.restarts_left = max_restarts
         with self._lock:
             if name is not None:
@@ -129,10 +134,29 @@ class ActorManager:
         # the same ClusterTaskManager lease path, SURVEY.md 3.4)
         crm = self._cluster.crm
         snapshot = crm.snapshot()
+        options = SchedulingOptions()
+        if rec.strategy.kind is SchedulingStrategyKind.PLACEMENT_GROUP:
+            verdict, options = self._cluster.pg_manager.\
+                scheduling_options_for(rec.strategy,
+                                       snapshot.node_mask.shape[0])
+            if verdict == "dead":
+                self._on_incarnation_dead(rec.actor_id, init_error=(
+                    RayTaskError("actor ctor", "placement group removed, "
+                                 "unknown, or bundle index out of range",
+                                 ActorDiedError())))
+                return
+            if verdict == "park":
+                # gang member before the gang is reserved: defer until the
+                # PG manager commits (its ready marker lands in the store)
+                from .placement_group_manager import ready_oid_for
+                self._store.on_ready(
+                    ready_oid_for(rec.strategy.placement_group_id),
+                    lambda _o: self._start_incarnation(rec))
+                return
         req = rec.resources.dense(crm.resource_index,
                                   snapshot.totals.shape[1])
-        row = HybridSchedulingPolicy().schedule(snapshot, req,
-                                                SchedulingOptions())
+        from ..scheduling.policy import CompositeSchedulingPolicy
+        row = CompositeSchedulingPolicy().schedule(snapshot, req, options)
         raylet = self._cluster.raylet_of_row(row) if row >= 0 else None
         if raylet is None:
             self._on_incarnation_dead(rec.actor_id, init_error=RayTaskError(

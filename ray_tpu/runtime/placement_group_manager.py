@@ -29,6 +29,13 @@ from ..scheduling.bundles import PlacementStrategy, schedule_bundles
 from .object_ref import ObjectRef
 
 
+def ready_oid_for(pg_id: PlacementGroupID) -> ObjectID:
+    """Deterministic ready-marker object id for a group: resolvable from
+    the pg id alone, so any process can await readiness."""
+    return ObjectID.for_task_return(
+        TaskID.deterministic(pg_id.binary(), _nil_actor()), 1)
+
+
 def shaped_name(base: str, pg_hex: str, bundle_index: int | None = None
                 ) -> str:
     if bundle_index is None:
@@ -39,9 +46,30 @@ def shaped_name(base: str, pg_hex: str, bundle_index: int | None = None
 def shape_request(resources: dict[str, float], pg_hex: str,
                   bundle_index: int = -1) -> dict[str, float]:
     """Rewrite a task's demand onto pg-shaped resources (reference: tasks
-    under a PlacementGroupSchedulingStrategy consume ``*_group_*``)."""
-    idx = None if bundle_index < 0 else bundle_index
-    return {shaped_name(k, pg_hex, idx): v for k, v in resources.items()}
+    under a PlacementGroupSchedulingStrategy consume ``*_group_*``).
+
+    Indexed demand consumes BOTH the indexed and the wildcard name — the
+    wildcard column is the node's total reserved capacity, so every
+    admission must debit it or an indexed task and a wildcard task would
+    both be admitted against one reserved bundle (reference behavior)."""
+    if bundle_index < 0:
+        return {shaped_name(k, pg_hex): v for k, v in resources.items()}
+    out = {}
+    for k, v in resources.items():
+        out[shaped_name(k, pg_hex, bundle_index)] = v
+        out[shaped_name(k, pg_hex)] = v
+    return out
+
+
+def _bundle_shaped_cu(bundle_req: ResourceRequest, pg_hex: str,
+                      bundle_index: int) -> dict[str, int]:
+    """The shaped cu columns one committed bundle surfaces on its node
+    (indexed + wildcard) — single source for reserve AND release."""
+    shaped: dict[str, int] = {}
+    for kname, cu in bundle_req.cu().items():
+        shaped[shaped_name(kname, pg_hex, bundle_index)] = cu
+        shaped[shaped_name(kname, pg_hex)] = cu
+    return shaped
 
 
 @dataclass
@@ -70,8 +98,7 @@ class PlacementGroupManager:
     def create(self, pg_id: PlacementGroupID,
                bundles: list[dict[str, float]], strategy: PlacementStrategy,
                name: str | None = None) -> ObjectID:
-        ready_oid = ObjectID.for_task_return(
-            TaskID.deterministic(pg_id.binary(), _nil_actor()), 1)
+        ready_oid = ready_oid_for(pg_id)
         rec = PlacementGroupRecord(pg_id, [dict(b) for b in bundles],
                                    strategy, name, ready_oid=ready_oid)
         with self._lock:
@@ -109,11 +136,8 @@ class PlacementGroupManager:
         # phase 2 — commit: surface the shaped bundle resources
         pg_hex = rec.pg_id.hex()
         for b, row in enumerate(rows):
-            shaped: dict[str, int] = {}
-            for kname, cu in reqs[b].cu().items():
-                shaped[shaped_name(kname, pg_hex, b)] = cu
-                shaped[shaped_name(kname, pg_hex)] = cu
-            self._crm.add_shaped_resources(int(row), shaped)
+            self._crm.add_shaped_resources(
+                int(row), _bundle_shaped_cu(reqs[b], pg_hex, b))
         rec.rows = [int(r) for r in rows]
         rec.state = "CREATED"
         self._store.put(rec.ready_oid, {
@@ -152,6 +176,35 @@ class PlacementGroupManager:
                     self._pending = still
             time.sleep(0.05)
 
+    # -- node death ---------------------------------------------------------
+    def on_node_removed(self, row: int) -> None:
+        """A node holding bundles died: release the group's surviving
+        reservations and send it back to pending for rescheduling
+        (reference: GcsPlacementGroupManager reschedules bundles of dead
+        nodes)."""
+        with self._lock:
+            for rec in self._groups.values():
+                if rec.state != "CREATED" or row not in rec.rows:
+                    continue
+                pg_hex = rec.pg_id.hex()
+                for b, r in enumerate(rec.rows):
+                    if r == row:
+                        continue            # dead node: resources are gone
+                    req = ResourceRequest(rec.bundles[b])
+                    self._crm.remove_shaped_resources(
+                        r, _bundle_shaped_cu(req, pg_hex, b))
+                    self._crm.add_back(r, req)
+                rec.rows = []
+                rec.state = "PENDING"
+                # retract the stale ready marker: pg.wait() must block
+                # until the group is re-reserved (and the deferred-actor
+                # on_ready path must not fire synchronously forever)
+                self._store.delete([rec.ready_oid])
+                if rec.pg_id not in self._pending:
+                    self._pending.append(rec.pg_id)
+            if self._pending:
+                self._ensure_ticker()
+
     # -- removal ------------------------------------------------------------
     def remove(self, pg_id: PlacementGroupID) -> None:
         with self._lock:
@@ -160,20 +213,50 @@ class PlacementGroupManager:
                 return
             if rec.state == "PENDING":
                 rec.state = "REMOVED"
+                self._store.delete([rec.ready_oid])
                 if pg_id in self._pending:
                     self._pending.remove(pg_id)
                 return
             pg_hex = pg_id.hex()
             for b, row in enumerate(rec.rows):
                 req = ResourceRequest(rec.bundles[b])
-                shaped: dict[str, int] = {}
-                for kname, cu in req.cu().items():
-                    shaped[shaped_name(kname, pg_hex, b)] = cu
-                    shaped[shaped_name(kname, pg_hex)] = cu
-                self._crm.remove_shaped_resources(row, shaped)
+                self._crm.remove_shaped_resources(
+                    row, _bundle_shaped_cu(req, pg_hex, b))
                 self._crm.add_back(row, req)
             rec.state = "REMOVED"
+            self._store.delete([rec.ready_oid])
         self._wake_raylets()
+
+    # -- strategy resolution (shared by raylet + actor manager) -------------
+    def scheduling_options_for(self, strategy, n_rows: int):
+        """Resolve a PLACEMENT_GROUP SchedulingStrategy into scheduling
+        options.  Returns (options, verdict):
+
+        * ("ok", options)   — group reserved; affinity/mask options
+        * ("park", options) — group pending; all-False mask (task parks
+                              until the commit wakes the raylets)
+        * ("dead", None)    — group removed/unknown/bad bundle index; the
+                              caller must FAIL the task/actor
+        """
+        import numpy as np
+
+        from ..scheduling.policy import SchedulingOptions, SchedulingType
+        with self._lock:
+            rec = self._groups.get(strategy.placement_group_id)
+            if rec is None or rec.state == "REMOVED":
+                return "dead", None
+            if strategy.bundle_index >= len(rec.bundles):
+                return "dead", None
+            if rec.state != "CREATED":
+                return "park", SchedulingOptions(
+                    node_mask=np.zeros(n_rows, dtype=bool))
+            if strategy.bundle_index >= 0:
+                return "ok", SchedulingOptions(
+                    scheduling_type=SchedulingType.NODE_AFFINITY,
+                    node_row=rec.rows[strategy.bundle_index], soft=False)
+            mask = np.zeros(n_rows, dtype=bool)
+            mask[[r for r in rec.rows if r < n_rows]] = True
+            return "ok", SchedulingOptions(node_mask=mask)
 
     # -- introspection ------------------------------------------------------
     def table(self) -> dict:

@@ -171,13 +171,20 @@ class Raylet:
                 self._dirty = False
                 batch = list(self._queue)
                 self._queue.clear()
-            if batch:
-                leftover = self._place_batch(batch)
-                if leftover:
-                    with self._cv:
-                        # infeasible-now tasks park at the front, in order
-                        self._queue.extendleft(reversed(leftover))
-            self._drain_local()
+            try:
+                if batch:
+                    leftover = self._place_batch(batch)
+                    if leftover:
+                        with self._cv:
+                            # infeasible-now tasks park at the front, in order
+                            self._queue.extendleft(reversed(leftover))
+                self._drain_local()
+            except Exception:   # noqa: BLE001 — one bad batch must not
+                # kill the node's scheduling thread (every later task
+                # would hang); the batch's tasks are lost to this round
+                # but retriable ones re-enter via their owners
+                import traceback
+                traceback.print_exc()
 
     # -- batch scheduling ---------------------------------------------------
     def _schedule_rows(self, batch: list) -> list[int]:
@@ -211,7 +218,9 @@ class Raylet:
                 self.crm.resource_index, snapshot.totals.shape[1])
             for t in idxs:
                 rows[t] = self._policy.schedule(
-                    snapshot, req, self._options_for(specs[t]))
+                    snapshot, req,
+                    self._options_for(specs[t],
+                                      snapshot.node_mask.shape[0]))
         return rows
 
     def _schedule_rows_device(self, specs: list) -> list[int]:
@@ -283,7 +292,7 @@ class Raylet:
             ).clip(-(2**30), 2**30).astype(np.int32)
         return snapshot
 
-    def _options_for(self, spec) -> SchedulingOptions:
+    def _options_for(self, spec, n_rows: int) -> SchedulingOptions:
         kind = spec.strategy.kind
         if kind is SchedulingStrategyKind.SPREAD:
             return SchedulingOptions(scheduling_type=SchedulingType.SPREAD)
@@ -293,6 +302,18 @@ class Raylet:
                 scheduling_type=SchedulingType.NODE_AFFINITY,
                 node_row=row if row is not None else -1,
                 soft=spec.strategy.soft)
+        if kind is SchedulingStrategyKind.PLACEMENT_GROUP:
+            # pin to the group's reserved bundles; a still-pending group
+            # parks the task (all-False mask) until the PG manager's
+            # commit wakes the raylets (SURVEY §3.5).  "dead" groups are
+            # failed earlier in _place_batch; park defensively if one
+            # races through here.
+            verdict, options = self.cluster.pg_manager.\
+                scheduling_options_for(spec.strategy, n_rows)
+            if verdict == "dead":
+                return SchedulingOptions(
+                    node_mask=np.zeros(n_rows, dtype=bool))
+            return options
         return SchedulingOptions()
 
     def _place_batch(self, batch: list[TaskID]) -> list[TaskID]:
@@ -301,8 +322,20 @@ class Raylet:
         recs = []
         for task_id in batch:
             rec = self.task_manager.get(task_id)
-            if rec is not None and not rec.done:
-                recs.append(rec)
+            if rec is None or rec.done:
+                continue
+            strat = rec.spec.strategy
+            if strat.kind is SchedulingStrategyKind.PLACEMENT_GROUP:
+                verdict, _ = self.cluster.pg_manager.\
+                    scheduling_options_for(strat, 0)
+                if verdict == "dead":
+                    # removed/unknown group or bad bundle index: fail the
+                    # task (reference: tasks of a removed PG error out)
+                    self._fail_unscheduled(
+                        rec, "placement group removed, unknown, or "
+                        "bundle index out of range")
+                    continue
+            recs.append(rec)
         if not recs:
             return []
         rows = self._schedule_rows(recs)
@@ -413,6 +446,14 @@ class Raylet:
         worker.dead = True
         self._enqueue(rec.spec.task_id)
 
+    def _fail_unscheduled(self, rec, message: str) -> None:
+        """Fail a task that never reached dispatch (no resources were
+        subtracted, no worker leased)."""
+        self.task_manager.complete(rec.spec.task_id)
+        err = RayTaskError(rec.spec.function_descriptor, message)
+        for oid in rec.return_ids:
+            self.store.put(oid, err)
+
     def _finish_with_error(self, rec, error: RayTaskError,
                            worker: WorkerHandle | None) -> None:
         self.task_manager.complete(rec.spec.task_id)
@@ -432,11 +473,11 @@ class Raylet:
                 return
             if kind == "actor_create":
                 from ..common.ids import ActorID
-                args, kwargs, max_restarts, max_task_retries, name, res = \
-                    deserialize(msg[4])
+                (args, kwargs, max_restarts, max_task_retries, name, res,
+                 strategy) = deserialize(msg[4])
                 am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
                                 kwargs, max_restarts, max_task_retries,
-                                name, resources=res)
+                                name, resources=res, strategy=strategy)
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
@@ -514,6 +555,16 @@ class Raylet:
             if fn_bytes is not None and fn_id not in self._fn_registry:
                 self._fn_registry[fn_id] = fn_bytes
             self.submit(spec)
+        elif kind == "pg_create":
+            from ..common.ids import PlacementGroupID
+            from ..scheduling.bundles import PlacementStrategy
+            bundles, strategy_name, name = deserialize(msg[2])
+            self.cluster.pg_manager.create(
+                PlacementGroupID(msg[1]), bundles,
+                PlacementStrategy[strategy_name], name=name)
+        elif kind == "pg_remove":
+            from ..common.ids import PlacementGroupID
+            self.cluster.pg_manager.remove(PlacementGroupID(msg[1]))
 
     @staticmethod
     def _oid(binary: bytes):

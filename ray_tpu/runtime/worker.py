@@ -107,11 +107,20 @@ class WorkerApiContext:
     # -- actor API (frames handled by the driver's ActorManager) ------------
     def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
                      args, kwargs, max_restarts: int, max_task_retries: int,
-                     name: str | None, resources=None):
+                     name: str | None, resources=None, strategy=None):
         self._conn.send(("actor_create", actor_id.binary(), cls_id,
                          cls_bytes, serialize(
                              (args, kwargs, max_restarts, max_task_retries,
-                              name, resources))))
+                              name, resources, strategy))))
+
+    # -- placement groups (frames handled by the raylet) --------------------
+    def create_placement_group(self, pg_id, bundles, strategy_name: str,
+                               name: str | None):
+        self._conn.send(("pg_create", pg_id.binary(),
+                         serialize((bundles, strategy_name, name))))
+
+    def remove_placement_group(self, pg_id):
+        self._conn.send(("pg_remove", pg_id.binary()))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
                           kwargs, num_returns: int):

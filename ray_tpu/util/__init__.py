@@ -9,4 +9,12 @@ lazily.
 
 from __future__ import annotations
 
-__all__: list[str] = []
+from .placement_group import (PlacementGroup, placement_group,
+                              placement_group_table,
+                              remove_placement_group)
+from .scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                    PlacementGroupSchedulingStrategy)
+
+__all__ = ["PlacementGroup", "placement_group", "placement_group_table",
+           "remove_placement_group", "PlacementGroupSchedulingStrategy",
+           "NodeAffinitySchedulingStrategy"]
