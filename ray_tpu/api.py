@@ -78,7 +78,9 @@ class DriverRuntime:
             idx = self._put_index
         from .common.ids import ObjectID
         oid = ObjectID.for_put(self.driver_task_id, idx)
-        self.store.put(oid, value)
+        # size-routed like the reference: large serialized payloads seal
+        # into the shared arena; small values stay in-band
+        self.store.put_value(oid, value, serialize(value))
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):

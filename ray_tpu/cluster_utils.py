@@ -15,8 +15,12 @@ raylet recomputes the same answer and dispatches locally).
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
+import uuid
 
+from .common.config import get_config
 from .common.ids import NodeID
 from .common.resources import NodeResources
 from .runtime.object_store import MemoryStore
@@ -26,11 +30,28 @@ from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
 
 
+def _make_arena(session_dir: str):
+    """Create the shared-memory arena backing the object store (plasma
+    analogue); /dev/shm when available, session dir otherwise."""
+    from .native import Arena
+    cfg = get_config()
+    capacity = cfg.object_store_memory_mb * 1024 * 1024
+    name = f"rt_arena_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+    try:
+        return Arena(os.path.join("/dev/shm", name), capacity, create=True)
+    except OSError:
+        return Arena(os.path.join(session_dir, name), capacity, create=True)
+
+
 class Cluster:
     def __init__(self):
         self._lock = threading.RLock()
         self.crm = ClusterResourceManager()
-        self.store = MemoryStore()
+        self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+        self.arena = _make_arena(self.session_dir)
+        spill_dir = get_config().object_spilling_dir or \
+            os.path.join(self.session_dir, "spill")
+        self.store = MemoryStore(arena=self.arena, spill_dir=spill_dir)
         self.task_manager = TaskManager()     # ownership is driver-central
         self.fn_registry: dict[str, bytes] = {}
         self.raylets: dict[int, Raylet] = {}  # row -> raylet
@@ -103,3 +124,6 @@ class Cluster:
             self.raylets.clear()
         for r in raylets:
             r.stop()
+        self.arena.close()
+        import shutil
+        shutil.rmtree(self.session_dir, ignore_errors=True)

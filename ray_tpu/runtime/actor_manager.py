@@ -295,9 +295,9 @@ class ActorManager:
                 return True
             if kind == "actor_result":
                 for i, data in enumerate(msg[2]):
-                    self._store.put(
+                    self._store.put_serialized(
                         ObjectID.for_task_return(call.task_id, i + 1),
-                        deserialize(data))
+                        data)
             else:
                 err = deserialize(msg[2])
                 for i in range(call.num_returns):

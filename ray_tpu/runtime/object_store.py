@@ -1,11 +1,19 @@
-"""In-process object store (the core-worker memory store analogue).
+"""Object store: in-band values + shared-memory arena + spill/restore.
 
-Reference parity: every upstream worker embeds an in-process memory store
-for small/in-band objects next to the plasma provider for large ones
-(``src/ray/core_worker/store_provider/memory_store/`` — SURVEY.md §1 layer
-7; mount empty).  This is the driver/worker-side store of the single-node
-slice; the shared-memory arena store (plasma analogue) plugs in behind the
-same interface for large objects.
+Reference parity: upstream splits objects between the core worker's
+in-process memory store (small/in-band) and the plasma shared-memory store
+(large, zero-copy mmap reads, spill to external storage past a threshold)
+— ``src/ray/core_worker/store_provider/memory_store/``,
+``src/ray/object_manager/plasma/``, ``LocalObjectManager`` spill
+(SURVEY.md §1 layers 6-7; mount empty).
+
+Routing: serialized payloads larger than ``max_direct_call_object_size``
+live in the native arena (``ray_tpu/native/arena.cc``) and are read
+zero-copy by worker processes attaching the same /dev/shm file; smaller
+objects are held in-band as Python values.  When arena occupancy crosses
+``object_spilling_threshold`` (or allocation fails), the least-recently-
+used sealed objects spill to ``object_spilling_dir`` and restore on
+demand (plasma's spill/restore semantics).
 
 Semantics carried over: objects are sealed-once immutable; ``get`` blocks
 with timeout; storing a ``RayTaskError`` poisons the object — every get
@@ -15,12 +23,15 @@ manager (task args become ready) without polling.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+from collections import OrderedDict
+from dataclasses import dataclass
 from typing import Callable, Iterable, Sequence
 
 from ..common.ids import ObjectID
-from .serialization import RayError, RayTaskError
+from .serialization import RayError, RayTaskError, deserialize
 
 
 class GetTimeoutError(RayError, TimeoutError):
@@ -32,52 +43,217 @@ class ObjectLostError(RayError):
     ``ray.exceptions.ObjectLostError``)."""
 
 
+class ObjectStoreFullError(RayError, MemoryError):
+    """Arena and spill both exhausted (reference:
+    ``ray.exceptions.ObjectStoreFullError``)."""
+
+
+@dataclass
+class ShmEntry:
+    """Sealed serialized payload resident in the shared arena."""
+    offset: int
+    size: int
+
+
+@dataclass
+class SpillEntry:
+    """Payload spilled to disk; restored to the arena on access."""
+    path: str
+    size: int
+
+
 class MemoryStore:
-    def __init__(self):
+    def __init__(self, arena=None, spill_dir: str | None = None,
+                 direct_call_threshold: int | None = None,
+                 spill_threshold: float | None = None):
+        from ..common.config import get_config
+        cfg = get_config()
         self._cv = threading.Condition()
-        self._objects: dict[ObjectID, object] = {}
+        # LRU order: least-recently-touched first (spill victims)
+        self._objects: "OrderedDict[ObjectID, object]" = OrderedDict()
         self._listeners: dict[ObjectID, list[Callable[[ObjectID], None]]] = {}
+        self.arena = arena
+        self._spill_dir = spill_dir
+        self._threshold = (direct_call_threshold
+                          if direct_call_threshold is not None
+                          else cfg.max_direct_call_object_size)
+        self._spill_frac = (spill_threshold if spill_threshold is not None
+                            else cfg.object_spilling_threshold)
+        self.spilled_bytes = 0
+        self.restored_bytes = 0
 
     # -- write --------------------------------------------------------------
     def put(self, object_id: ObjectID, value) -> None:
+        """Seal an in-band Python value (first write wins)."""
         with self._cv:
             if object_id in self._objects:
-                return                      # sealed-once: first write wins
+                return
             self._objects[object_id] = value
             listeners = self._listeners.pop(object_id, ())
             self._cv.notify_all()
         for cb in listeners:
             cb(object_id)
 
+    def put_value(self, object_id: ObjectID, value, serialized=None
+                  ) -> None:
+        """Seal a value whose serialized form is already known: routes
+        large payloads to the arena without re-deserializing small ones."""
+        if serialized is not None and self.arena is not None and \
+                len(serialized) > self._threshold:
+            self.put_serialized(object_id, serialized)
+        else:
+            self.put(object_id, value)
+
+    def put_serialized(self, object_id: ObjectID, data) -> None:
+        """Seal a serialized payload, routing by size: large payloads go
+        to the shared arena (zero-copy reads), small ones are held in-band
+        as the deserialized value."""
+        data = memoryview(data)
+        if self.arena is None or data.nbytes <= self._threshold:
+            self.put(object_id, deserialize(data))
+            return
+        with self._cv:
+            if object_id in self._objects:
+                return
+            entry = self._shm_put_locked(data)
+            self._objects[object_id] = entry
+            listeners = self._listeners.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
+    def _shm_put_locked(self, data) -> ShmEntry:
+        """Allocate+copy into the arena, spilling LRU victims as needed.
+        Caller holds the lock."""
+        from ..native import ArenaFullError
+        self._maybe_spill_locked(data.nbytes)
+        while True:
+            try:
+                off = self.arena.alloc(data.nbytes)
+                break
+            except ArenaFullError:
+                if not self._spill_one_locked():
+                    raise ObjectStoreFullError(
+                        f"object store full: cannot place {data.nbytes} "
+                        f"bytes (capacity {self.arena.capacity()})")
+        self.arena.write(off, data)
+        return ShmEntry(off, data.nbytes)
+
+    def _maybe_spill_locked(self, incoming: int) -> None:
+        if self.arena is None:
+            return
+        budget = int(self.arena.capacity() * self._spill_frac)
+        while self.arena.bytes_in_use() + incoming > budget:
+            if not self._spill_one_locked():
+                break
+
+    def _spill_one_locked(self) -> bool:
+        """Spill the least-recently-used shm object to disk."""
+        victim = None
+        for oid, entry in self._objects.items():      # LRU first
+            if isinstance(entry, ShmEntry):
+                victim = (oid, entry)
+                break
+        if victim is None or self._spill_dir is None:
+            return False
+        oid, entry = victim
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(self.arena.view(entry.offset, entry.size))
+        self.arena.free(entry.offset)
+        self._objects[oid] = SpillEntry(path, entry.size)
+        self.spilled_bytes += entry.size
+        return True
+
+    def _restore_locked(self, object_id: ObjectID,
+                        entry: SpillEntry) -> ShmEntry | bytes:
+        """Bring a spilled payload back; prefer the arena (zero-copy for
+        readers), fall back to raw bytes if it cannot fit."""
+        with open(entry.path, "rb") as f:
+            data = f.read()
+        self.restored_bytes += len(data)
+        try:
+            shm = self._shm_put_locked(memoryview(data))
+        except ObjectStoreFullError:
+            return data
+        os.unlink(entry.path)
+        self._objects[object_id] = shm
+        return shm
+
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
         with self._cv:
             for oid in object_ids:
-                self._objects.pop(oid, None)
+                entry = self._objects.pop(oid, None)
+                self._release_entry(entry)
+
+    def _release_entry(self, entry) -> None:
+        if isinstance(entry, ShmEntry) and self.arena is not None:
+            self.arena.free(entry.offset)
+        elif isinstance(entry, SpillEntry):
+            try:
+                os.unlink(entry.path)
+            except OSError:
+                pass
+
+    # -- materialization ----------------------------------------------------
+    def _value_locked(self, object_id: ObjectID):
+        """Deserialize/restore an entry into a Python value; touches LRU."""
+        entry = self._objects[object_id]
+        self._objects.move_to_end(object_id)
+        if isinstance(entry, SpillEntry):
+            entry = self._restore_locked(object_id, entry)
+            if isinstance(entry, bytes):
+                return deserialize(entry)
+        if isinstance(entry, ShmEntry):
+            return deserialize(self.arena.view(entry.offset, entry.size))
+        return entry
+
+    def _descriptor_locked(self, object_id: ObjectID):
+        """Wire form for worker replies: ("v", value) in-band, or
+        ("s", offset, size) for zero-copy shm reads.  Spilled objects are
+        restored first; if the arena can't take them, bytes go in-band."""
+        entry = self._objects[object_id]
+        self._objects.move_to_end(object_id)
+        if isinstance(entry, SpillEntry):
+            entry = self._restore_locked(object_id, entry)
+            if isinstance(entry, bytes):
+                return ("b", entry)
+        if isinstance(entry, ShmEntry):
+            return ("s", entry.offset, entry.size)
+        return ("v", entry)
 
     # -- read ---------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
         with self._cv:
             return object_id in self._objects
 
+    def _await_locked(self, object_ids: Sequence[ObjectID],
+                      deadline: float | None) -> bool:
+        """Wait (caller holds lock) until all ids exist. False on timeout."""
+        while True:
+            missing = [o for o in object_ids if o not in self._objects]
+            if not missing:
+                return True
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            else:
+                self._cv.wait()
+
     def get(self, object_ids: Sequence[ObjectID],
             timeout: float | None = None) -> list:
         """Blocking fetch of all ids (in order). Raises stored errors."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while True:
-                missing = [o for o in object_ids if o not in self._objects]
-                if not missing:
-                    break
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise GetTimeoutError(
-                            f"get timed out; {len(missing)} of "
-                            f"{len(object_ids)} objects not ready")
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
-            values = [self._objects[o] for o in object_ids]
+            if not self._await_locked(object_ids, deadline):
+                missing = sum(o not in self._objects for o in object_ids)
+                raise GetTimeoutError(
+                    f"get timed out; {missing} of {len(object_ids)} "
+                    "objects not ready")
+            values = [self._value_locked(o) for o in object_ids]
         for v in values:
             if isinstance(v, RayTaskError):
                 raise v.cause if v.cause is not None else v
@@ -113,20 +289,31 @@ class MemoryStore:
         Returns None on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while any(o not in self._objects for o in object_ids):
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return None
-                    self._cv.wait(remaining)
-                else:
-                    self._cv.wait()
-            return [self._objects[o] for o in object_ids]
+            if not self._await_locked(object_ids, deadline):
+                return None
+            return [self._value_locked(o) for o in object_ids]
+
+    def get_descriptors_blocking(self, object_ids: Sequence[ObjectID],
+                                 timeout: float | None = None
+                                 ) -> list | None:
+        """Blocking fetch of wire descriptors for a worker reply: shm
+        objects ship as (offset, size) for zero-copy reads, small ones as
+        in-band values.  Returns None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            if not self._await_locked(object_ids, deadline):
+                return None
+            return [self._descriptor_locked(o) for o in object_ids]
 
     def peek(self, object_id: ObjectID):
-        """Non-blocking raw read (no error unwrap); KeyError if absent."""
+        """Non-blocking read (materializes); KeyError if absent."""
         with self._cv:
-            return self._objects[object_id]
+            return self._value_locked(object_id)
+
+    def descriptor_of(self, object_id: ObjectID):
+        """Non-blocking wire descriptor; KeyError if absent."""
+        with self._cv:
+            return self._descriptor_locked(object_id)
 
     # -- listeners (dependency manager hook) --------------------------------
     def on_ready(self, object_id: ObjectID,
@@ -139,6 +326,25 @@ class MemoryStore:
                 return
         callback(object_id)
 
+    # -- introspection ------------------------------------------------------
     def size(self) -> int:
         with self._cv:
             return len(self._objects)
+
+    def stats(self) -> dict:
+        with self._cv:
+            shm = sum(isinstance(e, ShmEntry)
+                      for e in self._objects.values())
+            spilled = sum(isinstance(e, SpillEntry)
+                          for e in self._objects.values())
+            return {
+                "num_objects": len(self._objects),
+                "num_shm": shm,
+                "num_spilled": spilled,
+                "arena_bytes_in_use": (self.arena.bytes_in_use()
+                                       if self.arena else 0),
+                "arena_capacity": (self.arena.capacity()
+                                   if self.arena else 0),
+                "spilled_bytes": self.spilled_bytes,
+                "restored_bytes": self.restored_bytes,
+            }

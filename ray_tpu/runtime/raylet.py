@@ -57,9 +57,11 @@ class Raylet:
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
+        arena = getattr(cluster, "arena", None)
         self.pool = WorkerPool(num_workers, self._on_worker_message,
                                self._on_worker_death,
-                               on_idle=self._notify_dirty)
+                               on_idle=self._notify_dirty,
+                               arena_path=arena.path if arena else None)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"raylet-{self.row}")
 
@@ -401,15 +403,18 @@ class Raylet:
     def _dispatch(self, worker: WorkerHandle, rec) -> bool:
         spec = rec.spec
         # resolve top-level ObjectRef args (deps are ready by construction)
+        # as store descriptors: shm-resident args reach the worker as
+        # (offset, size) and are read zero-copy; errors are always in-band
+        from .worker import ArgRef
         args = []
         dep_error = None
         for a in spec.args:
             if isinstance(a, ObjectRef):
-                v = self.store.peek(a.id)
-                if isinstance(v, RayTaskError):
-                    dep_error = v
+                desc = self.store.descriptor_of(a.id)
+                if desc[0] == "v" and isinstance(desc[1], RayTaskError):
+                    dep_error = desc[1]
                     break
-                args.append(v)
+                args.append(ArgRef(desc))
             else:
                 args.append(a)
         if dep_error is not None:
@@ -506,7 +511,9 @@ class Raylet:
             if rec is not None:
                 if kind == "result":
                     for oid, data in zip(rec.return_ids, msg[2]):
-                        self.store.put(oid, deserialize(data))
+                        # size-routed: large payloads seal into the shared
+                        # arena (zero-copy reads), small ones in-band
+                        self.store.put_serialized(oid, data)
                 else:
                     err = deserialize(msg[2])
                     for oid in rec.return_ids:
@@ -517,9 +524,11 @@ class Raylet:
         elif kind == "get":
             oids = [self._oid(b) for b in msg[1]]
             timeout = msg[2] if len(msg) > 2 else None
+            # descriptors: shm objects reply as (offset, size) for a
+            # zero-copy read on the worker's own arena mapping
             if all(self.store.contains(o) for o in oids):
                 worker.send(("get_reply", serialize(
-                    ("ok", self.store.get_raw_blocking(oids)))))
+                    ("ok", self.store.get_descriptors_blocking(oids)))))
                 return
             # Blocking get: release the task's resources while the worker
             # waits (reference: CPU is returned during ray.get so dependent
@@ -527,12 +536,13 @@ class Raylet:
             # recursive fan-out deadlocks on worker slots.
             rec = self._rec_of_worker(worker)
             self._enter_blocked(worker, rec)
-            values = self.store.get_raw_blocking(oids, timeout=timeout)
+            descs = self.store.get_descriptors_blocking(oids,
+                                                        timeout=timeout)
             self._exit_blocked(worker, rec)
-            if values is None:
+            if descs is None:
                 worker.send(("get_reply", serialize(("timeout", None))))
             else:
-                worker.send(("get_reply", serialize(("ok", values))))
+                worker.send(("get_reply", serialize(("ok", descs))))
         elif kind == "wait":
             oids = [self._oid(b) for b in msg[1]]
             num_returns = min(msg[2], len(oids))
@@ -548,7 +558,7 @@ class Raylet:
             worker.send(("wait_reply",
                          serialize([o.binary() for o in ready])))
         elif kind == "put":
-            self.store.put(self._oid(msg[1]), deserialize(msg[2]))
+            self.store.put_serialized(self._oid(msg[1]), msg[2])
         elif kind == "submit":
             spec = deserialize(msg[1])
             fn_id, fn_bytes = msg[2], msg[3]

@@ -31,6 +31,20 @@ from .object_ref import ObjectRef
 from .serialization import RayTaskError, deserialize, serialize
 
 
+class ArgRef:
+    """A task argument shipped as a store descriptor instead of a value:
+    shm-resident args are read zero-copy from the worker's arena mapping
+    (reference: plasma args are mmap views, not copies)."""
+
+    __slots__ = ("desc",)
+
+    def __init__(self, desc):
+        self.desc = desc
+
+    def __reduce__(self):
+        return (ArgRef, (self.desc,))
+
+
 class WorkerApiContext:
     """The in-worker implementation of the public API (get/put/submit).
 
@@ -40,15 +54,31 @@ class WorkerApiContext:
 
     is_driver = False
 
-    def __init__(self, conn):
+    def __init__(self, conn, arena_path: str | None = None):
         self._conn = conn
         self._task_id: TaskID | None = None
         self._put_index = 0
+        self._arena_path = arena_path
+        self._arena = None          # lazily attached, read-only
         # frames that arrived while this worker was waiting for a reply
         # (pipelined actor calls land mid-get); the main loop drains them
         # in order after the current task finishes
         from collections import deque
         self.pending_frames = deque()
+
+    def _materialize(self, desc):
+        """Resolve a get-reply descriptor: in-band value, in-band bytes,
+        or a zero-copy read of the shared arena."""
+        kind = desc[0]
+        if kind == "v":
+            return desc[1]
+        if kind == "b":
+            return deserialize(desc[1])
+        # ("s", offset, size): attach the arena once, read zero-copy
+        if self._arena is None:
+            from ..native import Arena
+            self._arena = Arena(self._arena_path)
+        return deserialize(self._arena.view(desc[1], desc[2]))
 
     def _recv_reply(self, expected_kind: str):
         while True:
@@ -73,11 +103,12 @@ class WorkerApiContext:
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
         self._conn.send(("get", [r.binary() for r in refs], timeout))
         _, payload = self._recv_reply("get_reply")
-        status, values = deserialize(payload)
+        status, descs = deserialize(payload)
         if status == "timeout":
             from .object_store import GetTimeoutError
             raise GetTimeoutError(
                 f"get timed out after {timeout}s inside worker")
+        values = [self._materialize(d) for d in descs]
         for v in values:
             if isinstance(v, RayTaskError):
                 raise v.cause if v.cause is not None else v
@@ -136,7 +167,8 @@ class WorkerApiContext:
         return self._recv_reply("named_actor_reply")[1]
 
 
-def worker_main(conn, worker_index: int) -> None:
+def worker_main(conn, worker_index: int,
+                arena_path: str | None = None) -> None:
     """Entry point of a spawned worker process."""
     # workers never own the TPU: the device data plane belongs to the
     # raylet/driver process; user task code that imports jax gets CPU
@@ -144,7 +176,7 @@ def worker_main(conn, worker_index: int) -> None:
 
     from .. import api
 
-    ctx = WorkerApiContext(conn)
+    ctx = WorkerApiContext(conn, arena_path)
     api._set_runtime(ctx)
     fn_table: dict[str, object] = {}
     actor_instance = None            # dedicated worker: one actor
@@ -165,6 +197,8 @@ def worker_main(conn, worker_index: int) -> None:
         elif kind == "exec":
             _, task_id_bin, fn_id, payload = msg
             args, kwargs, num_returns = deserialize(payload)
+            args = tuple(ctx._materialize(a.desc) if isinstance(a, ArgRef)
+                         else a for a in args)
             fn = fn_table[fn_id]
             name = getattr(fn, "__qualname__", str(fn))
             ctx.begin_task(TaskID(task_id_bin))

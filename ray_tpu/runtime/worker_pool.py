@@ -56,11 +56,13 @@ class WorkerPool:
     def __init__(self, num_workers: int,
                  on_message: Callable[[WorkerHandle, tuple], None],
                  on_death: Callable[[WorkerHandle], None],
-                 on_idle: Callable[[], None] | None = None):
+                 on_idle: Callable[[], None] | None = None,
+                 arena_path: str | None = None):
         self._num = num_workers
         self._on_message = on_message
         self._on_death = on_death
         self._on_idle = on_idle or (lambda: None)
+        self._arena_path = arena_path
         self._ctx = mp.get_context("spawn")
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -86,7 +88,8 @@ class WorkerPool:
                      if k in os.environ}
             try:
                 proc = self._ctx.Process(
-                    target=worker_main, args=(child_conn, index),
+                    target=worker_main,
+                    args=(child_conn, index, self._arena_path),
                     daemon=True, name=f"rt-worker-{index}")
                 proc.start()
             finally:
