@@ -2,10 +2,13 @@
 
 A ground-up rebuild of the capabilities of the reference
 (``pschafhalter/ray``, a fork of ``ray-project/ray``): dynamic task graph +
-actor runtime, two-level scheduling, placement groups, shared-memory object
-store with pull-based transfer and spill, lineage fault recovery, autoscaler,
-and observability — with the scheduling data plane evaluated as dense TPU
-computations (JAX/XLA/Pallas) per BASELINE.json's north star.
+actor runtime, two-level scheduling, placement groups, and a shared-memory
+object store (native C++ arena, zero-copy worker reads, descriptor pinning,
+LRU spill/restore) — with the scheduling data plane evaluated as dense TPU
+computations (JAX/XLA/Pallas) per BASELINE.json's north star.  The
+autoscaler's bin-packing runs on-device; remaining reference subsystems
+(inter-node transfer, lineage recovery, observability) are tracked in
+VERDICT.md and land incrementally.
 
 Public API mirrors the reference's (``ray.init/remote/get/put/wait/...``,
 SURVEY.md §1 layer 9).

@@ -30,6 +30,39 @@ from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
 
 
+def reap_stale_arenas(shm_dir: str = "/dev/shm") -> int:
+    """Unlink arena files left by dead sessions (a killed owner never runs
+    ``Arena.close``; upstream similarly cleans stale per-session state at
+    startup).  Arena names embed the owner pid: ``rt_arena_<pid>_<tag>``.
+    Returns the number of files reaped."""
+    reaped = 0
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.startswith("rt_arena_"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[2])
+        except (IndexError, ValueError):
+            continue
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)             # owner alive?
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(shm_dir, name))
+                reaped += 1
+            except OSError:
+                pass
+        except PermissionError:
+            pass                        # alive, owned by another user
+    return reaped
+
+
 def _make_arena(session_dir: str):
     """Create the shared-memory arena backing the object store (plasma
     analogue); /dev/shm when available, session dir otherwise."""
@@ -38,6 +71,7 @@ def _make_arena(session_dir: str):
     capacity = cfg.object_store_memory_mb * 1024 * 1024
     name = f"rt_arena_{os.getpid()}_{uuid.uuid4().hex[:8]}"
     try:
+        reap_stale_arenas("/dev/shm")
         return Arena(os.path.join("/dev/shm", name), capacity, create=True)
     except OSError:
         return Arena(os.path.join(session_dir, name), capacity, create=True)

@@ -10,6 +10,7 @@ change with the baked-in g++ and cached next to the source.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import mmap
 import os
 import subprocess
@@ -17,29 +18,40 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "arena.cc")
-_LIB = os.path.join(_HERE, "_libarena.so")
 _build_lock = threading.Lock()
 _lib_handle = None
 
 
+def _lib_path() -> str:
+    """Cache key is a CONTENT hash of the source, embedded in the library
+    filename: mtimes are not preserved by git checkouts, so an mtime test
+    could silently load a stale binary with a mismatched shared-memory
+    layout.  Build artifacts are never committed (.gitignore *.so)."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_HERE, f"_libarena_{digest}.so")
+
+
 def _ensure_built() -> str:
-    """Compile arena.cc -> _libarena.so if missing or stale."""
-    if os.path.exists(_LIB) and \
-            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+    """Compile arena.cc -> _libarena_<srchash>.so if not already cached."""
+    lib = _lib_path()
+    if os.path.exists(lib):
+        return lib
     with _build_lock:
-        if os.path.exists(_LIB) and \
-                os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-            return _LIB
-        tmp = _LIB + f".tmp.{os.getpid()}"
+        if os.path.exists(lib):
+            return lib
+        tmp = lib + f".tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-fPIC", "-shared", "-pthread",
                "-o", tmp, _SRC]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
-        os.replace(tmp, _LIB)
-    return _LIB
+        os.replace(tmp, lib)
+        # older-revision caches are left in place: a concurrent process
+        # may be between building and dlopening one (they are a few KB
+        # and gitignored, so accumulation is harmless)
+    return lib
 
 
 def _lib():

@@ -50,9 +50,14 @@ class ObjectStoreFullError(RayError, MemoryError):
 
 @dataclass
 class ShmEntry:
-    """Sealed serialized payload resident in the shared arena."""
+    """Sealed serialized payload resident in the shared arena.
+
+    ``pins`` counts descriptors currently handed out to workers (plasma's
+    in-use semantics): a pinned entry is never spilled or freed, so the
+    worker's zero-copy read cannot race a reallocation of its block."""
     offset: int
     size: int
+    pins: int = 0
 
 
 @dataclass
@@ -81,6 +86,11 @@ class MemoryStore:
                             else cfg.object_spilling_threshold)
         self.spilled_bytes = 0
         self.restored_bytes = 0
+        # deleted-while-pinned shm entries, keyed by (oid, offset) so a
+        # re-seal + re-delete of the same object id cannot overwrite an
+        # older zombie; the block is freed only when the last outstanding
+        # descriptor is unpinned
+        self._zombies: dict[tuple[ObjectID, int], ShmEntry] = {}
 
     # -- write --------------------------------------------------------------
     def put(self, object_id: ObjectID, value) -> None:
@@ -115,7 +125,17 @@ class MemoryStore:
         with self._cv:
             if object_id in self._objects:
                 return
-            entry = self._shm_put_locked(data)
+            try:
+                entry = self._shm_put_locked(data)
+            except ObjectStoreFullError:
+                # arena exhausted even after spilling (e.g. one payload
+                # larger than the arena, or everything pinned): never
+                # strand waiters — spill the payload straight to disk, or
+                # hold it in-band when there is no spill dir (the restore
+                # path's bytes fallback, in reverse)
+                entry = self._spill_direct_locked(object_id, data)
+                if entry is None:
+                    entry = deserialize(data)
             self._objects[object_id] = entry
             listeners = self._listeners.pop(object_id, ())
             self._cv.notify_all()
@@ -126,6 +146,13 @@ class MemoryStore:
         """Allocate+copy into the arena, spilling LRU victims as needed.
         Caller holds the lock."""
         from ..native import ArenaFullError
+        if data.nbytes >= self.arena.capacity():
+            # can NEVER fit: fail fast instead of evicting the whole
+            # arena first (an over-capacity object would otherwise turn
+            # every restore attempt into a full spill storm)
+            raise ObjectStoreFullError(
+                f"payload of {data.nbytes} bytes exceeds arena capacity "
+                f"{self.arena.capacity()}")
         self._maybe_spill_locked(data.nbytes)
         while True:
             try:
@@ -148,23 +175,40 @@ class MemoryStore:
                 break
 
     def _spill_one_locked(self) -> bool:
-        """Spill the least-recently-used shm object to disk."""
+        """Spill the least-recently-used UNPINNED shm object to disk.
+        Pinned entries are skipped: a worker may hold their (offset, size)
+        descriptor and read the block at any moment."""
         victim = None
         for oid, entry in self._objects.items():      # LRU first
-            if isinstance(entry, ShmEntry):
+            if isinstance(entry, ShmEntry) and entry.pins == 0:
                 victim = (oid, entry)
                 break
         if victim is None or self._spill_dir is None:
             return False
         oid, entry = victim
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, oid.hex())
-        with open(path, "wb") as f:
-            f.write(self.arena.view(entry.offset, entry.size))
+        path = self._write_spill_file(oid, self.arena.view(entry.offset,
+                                                           entry.size))
         self.arena.free(entry.offset)
         self._objects[oid] = SpillEntry(path, entry.size)
         self.spilled_bytes += entry.size
         return True
+
+    def _write_spill_file(self, object_id: ObjectID, data) -> str:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def _spill_direct_locked(self, object_id: ObjectID,
+                             data) -> SpillEntry | None:
+        """Payload that cannot enter the arena goes straight to disk
+        (sealed as a SpillEntry); None when no spill dir is configured."""
+        if self._spill_dir is None:
+            return None
+        path = self._write_spill_file(object_id, data)
+        self.spilled_bytes += data.nbytes
+        return SpillEntry(path, data.nbytes)
 
     def _restore_locked(self, object_id: ObjectID,
                         entry: SpillEntry) -> ShmEntry | bytes:
@@ -185,7 +229,43 @@ class MemoryStore:
         with self._cv:
             for oid in object_ids:
                 entry = self._objects.pop(oid, None)
+                if isinstance(entry, ShmEntry) and entry.pins > 0:
+                    # a worker still holds a descriptor: defer the free
+                    # until the last unpin (plasma: delete waits for the
+                    # in-use count to drop)
+                    self._zombies[(oid, entry.offset)] = entry
+                    continue
                 self._release_entry(entry)
+
+    def unpin(self, pins: Iterable) -> None:
+        """Release descriptor pins taken by ``descriptor_of`` /
+        ``get_descriptors_blocking`` (one unpin per shm descriptor handed
+        out).  Each pin is an ObjectID or an ``(ObjectID, offset)`` pair;
+        the offset disambiguates a deleted-while-pinned block from a
+        later re-seal of the same object id (the offset is unique while
+        the block stays allocated).  Frees deleted-while-pinned blocks at
+        pin count zero."""
+        with self._cv:
+            for p in pins:
+                oid, off = p if isinstance(p, tuple) else (p, None)
+                entry = self._objects.get(oid)
+                if isinstance(entry, ShmEntry) and \
+                        (off is None or entry.offset == off):
+                    if entry.pins > 0:
+                        entry.pins -= 1
+                    continue
+                if off is not None:
+                    zkey = (oid, off)
+                else:       # id-only unpin: any zombie of this object
+                    zkey = next((k for k in self._zombies if k[0] == oid),
+                                None)
+                z = self._zombies.get(zkey) if zkey is not None else None
+                if z is not None:
+                    z.pins -= 1
+                    if z.pins <= 0:
+                        del self._zombies[zkey]
+                        self._release_entry(z)
+            self._cv.notify_all()
 
     def _release_entry(self, entry) -> None:
         if isinstance(entry, ShmEntry) and self.arena is not None:
@@ -212,7 +292,9 @@ class MemoryStore:
     def _descriptor_locked(self, object_id: ObjectID):
         """Wire form for worker replies: ("v", value) in-band, or
         ("s", offset, size) for zero-copy shm reads.  Spilled objects are
-        restored first; if the arena can't take them, bytes go in-band."""
+        restored first; if the arena can't take them, bytes go in-band.
+        Shm descriptors PIN the entry — the caller owes one
+        ``unpin([object_id])`` once the worker is done with the block."""
         entry = self._objects[object_id]
         self._objects.move_to_end(object_id)
         if isinstance(entry, SpillEntry):
@@ -220,6 +302,7 @@ class MemoryStore:
             if isinstance(entry, bytes):
                 return ("b", entry)
         if isinstance(entry, ShmEntry):
+            entry.pins += 1
             return ("s", entry.offset, entry.size)
         return ("v", entry)
 
@@ -341,6 +424,9 @@ class MemoryStore:
                 "num_objects": len(self._objects),
                 "num_shm": shm,
                 "num_spilled": spilled,
+                "num_pinned": sum(
+                    isinstance(e, ShmEntry) and e.pins > 0
+                    for e in self._objects.values()),
                 "arena_bytes_in_use": (self.arena.bytes_in_use()
                                        if self.arena else 0),
                 "arena_capacity": (self.arena.capacity()

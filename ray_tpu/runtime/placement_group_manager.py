@@ -213,9 +213,19 @@ class PlacementGroupManager:
                 return
             if rec.state == "PENDING":
                 rec.state = "REMOVED"
-                self._store.delete([rec.ready_oid])
                 if pg_id in self._pending:
                     self._pending.remove(pg_id)
+                # seal the ready marker with an error: waiters (pg.ready()
+                # gets, actors parked on the marker) must WAKE and fail,
+                # not hang forever (reference: actor creation fails when
+                # its placement group is removed).  Woken actors re-resolve
+                # through scheduling_options_for -> "dead" -> ActorDied.
+                from .serialization import RayTaskError
+                self._store.put(rec.ready_oid, RayTaskError(
+                    "placement_group.ready",
+                    f"placement group {pg_id.hex()[:12]} was removed "
+                    "while pending"))
+                self._wake_raylets()
                 return
             pg_hex = pg_id.hex()
             for b, row in enumerate(rec.rows):
@@ -230,7 +240,7 @@ class PlacementGroupManager:
     # -- strategy resolution (shared by raylet + actor manager) -------------
     def scheduling_options_for(self, strategy, n_rows: int):
         """Resolve a PLACEMENT_GROUP SchedulingStrategy into scheduling
-        options.  Returns (options, verdict):
+        options.  Returns (verdict, options):
 
         * ("ok", options)   — group reserved; affinity/mask options
         * ("park", options) — group pending; all-False mask (task parks

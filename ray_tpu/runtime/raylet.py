@@ -53,7 +53,8 @@ class Raylet:
         self._local_queue: deque[TaskID] = deque()  # placed here, await dispatch
         self._planned_cu = None     # dense planned-load vector (lazy width)
         self._waiting: dict[TaskID, int] = {}   # task -> missing dep count
-        self._running: dict[bytes, tuple[TaskID, WorkerHandle]] = {}
+        # task_id_bin -> (TaskID, WorkerHandle, pinned shm-arg batch)
+        self._running: dict[bytes, tuple[TaskID, WorkerHandle, list]] = {}
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
@@ -407,10 +408,13 @@ class Raylet:
         # (offset, size) and are read zero-copy; errors are always in-band
         from .worker import ArgRef
         args = []
+        pinned: list = []       # shm args stay pinned until task completion
         dep_error = None
         for a in spec.args:
             if isinstance(a, ObjectRef):
                 desc = self.store.descriptor_of(a.id)
+                if desc[0] == "s":
+                    pinned.append((a.id, desc[1]))
                 if desc[0] == "v" and isinstance(desc[1], RayTaskError):
                     dep_error = desc[1]
                     break
@@ -420,6 +424,7 @@ class Raylet:
         if dep_error is not None:
             # propagate the dependency's error to this task's outputs
             # without executing (reference: failed deps fail the task)
+            self.store.unpin(pinned)
             self._finish_with_error(rec, dep_error, worker)
             return False
 
@@ -427,22 +432,30 @@ class Raylet:
         if fn_id not in worker.fn_cache:
             fn_bytes = self._fn_registry.get(fn_id)
             if fn_bytes is None:
+                self.store.unpin(pinned)
                 self._finish_with_error(rec, RayTaskError(
                     fn_id, "function bytes never reached the driver "
                     "(stub submitted without registration)"), worker)
                 return False
             if not worker.send(("fn", fn_id, fn_bytes)):
+                self.store.unpin(pinned)
                 self._requeue_after_worker_loss(rec, worker)
                 return False
             worker.fn_cache.add(fn_id)
         payload = serialize((tuple(args), spec.kwargs, spec.num_returns))
         worker.leased_task = spec.task_id.binary()
         with self._cv:
-            self._running[spec.task_id.binary()] = (spec.task_id, worker)
+            self._running[spec.task_id.binary()] = (spec.task_id, worker,
+                                                    pinned)
         if not worker.send(("exec", spec.task_id.binary(), fn_id, payload)):
             with self._cv:
-                self._running.pop(spec.task_id.binary(), None)
-            self._requeue_after_worker_loss(rec, worker)
+                entry = self._running.pop(spec.task_id.binary(), None)
+            if entry is not None:
+                # a concurrent _on_worker_death that popped first already
+                # unpinned, returned resources, and retried/failed the
+                # task — doing it again here would double-release
+                self.store.unpin(pinned)
+                self._requeue_after_worker_loss(rec, worker)
             return False
         return True
 
@@ -506,7 +519,8 @@ class Raylet:
             if entry is None:
                 self.pool.release(worker)
                 return
-            task_id, _ = entry
+            task_id, _, pinned = entry
+            self.store.unpin(pinned)    # task done: release shm arg pins
             rec = self.task_manager.complete(task_id)
             if rec is not None:
                 if kind == "result":
@@ -527,8 +541,8 @@ class Raylet:
             # descriptors: shm objects reply as (offset, size) for a
             # zero-copy read on the worker's own arena mapping
             if all(self.store.contains(o) for o in oids):
-                worker.send(("get_reply", serialize(
-                    ("ok", self.store.get_descriptors_blocking(oids)))))
+                descs = self.store.get_descriptors_blocking(oids)
+                self._send_get_reply(worker, oids, descs)
                 return
             # Blocking get: release the task's resources while the worker
             # waits (reference: CPU is returned during ray.get so dependent
@@ -542,7 +556,16 @@ class Raylet:
             if descs is None:
                 worker.send(("get_reply", serialize(("timeout", None))))
             else:
-                worker.send(("get_reply", serialize(("ok", descs))))
+                self._send_get_reply(worker, oids, descs)
+        elif kind == "get_ack":
+            # the worker finished its zero-copy reads of the oldest
+            # outstanding get reply: release those pins (FIFO — the
+            # single-threaded worker acks replies in receive order)
+            with worker.pin_lock:
+                batch = (worker.pending_get_pins.popleft()
+                         if worker.pending_get_pins else None)
+            if batch:
+                self.store.unpin(batch)
         elif kind == "wait":
             oids = [self._oid(b) for b in msg[1]]
             num_returns = min(msg[2], len(oids))
@@ -576,10 +599,43 @@ class Raylet:
             from ..common.ids import PlacementGroupID
             self.cluster.pg_manager.remove(PlacementGroupID(msg[1]))
 
+    def _send_get_reply(self, worker: WorkerHandle, oids, descs) -> None:
+        """Ship get descriptors; shm descriptors were pinned by the store,
+        so record them for release on the worker's get_ack (every reply
+        with shm descriptors gets exactly one ack)."""
+        shm_pins = [(o, d[1]) for o, d in zip(oids, descs) if d[0] == "s"]
+        if shm_pins:
+            with worker.pin_lock:
+                if worker.no_more_pins:
+                    # worker is being drained/killed: drop the reply (it
+                    # will never be read) and release the pins now
+                    self.store.unpin(shm_pins)
+                    return
+                worker.pending_get_pins.append(shm_pins)
+        if not worker.send(("get_reply", serialize(("ok", descs)))) \
+                and shm_pins:
+            with worker.pin_lock:
+                try:
+                    worker.pending_get_pins.remove(shm_pins)
+                except ValueError:
+                    return          # a concurrent drain already released
+            self.store.unpin(shm_pins)
+
     @staticmethod
     def _oid(binary: bytes):
         from ..common.ids import ObjectID
         return ObjectID(binary)
+
+    def _drain_worker_pins(self, worker: WorkerHandle) -> None:
+        """Release every un-acked get-reply pin of a dead/draining worker
+        and latch out further appends (a reader thread may still be
+        finishing a blocking get for it)."""
+        with worker.pin_lock:
+            worker.no_more_pins = True
+            batches = list(worker.pending_get_pins)
+            worker.pending_get_pins.clear()
+        for batch in batches:
+            self.store.unpin(batch)
 
     def _rec_of_worker(self, worker: WorkerHandle):
         """TaskRecord of the task the worker is currently executing."""
@@ -619,6 +675,8 @@ class Raylet:
             time.sleep(0.002)
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
+        self._drain_worker_pins(worker)
+
         if self.actor_manager is not None and \
                 self.actor_manager.on_worker_death(worker):
             return
@@ -629,7 +687,8 @@ class Raylet:
             entry = self._running.pop(task_id_bin, None)
         if entry is None:
             return
-        task_id, _ = entry
+        task_id, _, pinned = entry
+        self.store.unpin(pinned)
         rec = self.task_manager.get(task_id)
         if rec is None:
             return
@@ -684,8 +743,7 @@ class Raylet:
                 return True
             entry = self._running.get(task_id.binary())
         if entry is not None and force:
-            _, worker = entry
-            self.pool.kill_worker(worker)   # death path handles bookkeeping
+            self.pool.kill_worker(entry[1])  # death path does bookkeeping
             return True
         return False
 
@@ -704,9 +762,16 @@ class Raylet:
             self._cv.notify_all()
         if self.actor_manager is not None:
             self.actor_manager.fail_actors_on_pool(self.pool)
+        # the pool shutdown suppresses per-worker death callbacks, so
+        # release descriptor pins (get replies + running-task args) here
+        with self.pool._lock:
+            workers = list(self.pool._workers)
+        for w in workers:
+            self._drain_worker_pins(w)
         for task_id in queued:
             fallback.enqueue_forwarded(task_id)
-        for _bin, (task_id, _w) in running:
+        for _bin, (task_id, _w, pinned) in running:
+            self.store.unpin(pinned)
             if self.task_manager.should_retry(task_id):
                 fallback.enqueue_forwarded(task_id)
             else:

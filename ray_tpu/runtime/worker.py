@@ -108,7 +108,13 @@ class WorkerApiContext:
             from .object_store import GetTimeoutError
             raise GetTimeoutError(
                 f"get timed out after {timeout}s inside worker")
-        values = [self._materialize(d) for d in descs]
+        try:
+            values = [self._materialize(d) for d in descs]
+        finally:
+            # ack releases the raylet-side pins on this reply's shm
+            # descriptors; sent only when the reply carried any
+            if any(d[0] == "s" for d in descs):
+                self._conn.send(("get_ack",))
         for v in values:
             if isinstance(v, RayTaskError):
                 raise v.cause if v.cause is not None else v

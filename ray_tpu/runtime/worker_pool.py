@@ -37,6 +37,14 @@ class WorkerHandle:
         self.dedicated = False              # actor worker: never in idle set
         self.leased_task = None             # task_id_bin while executing
         self.fn_cache: set[str] = set()
+        # FIFO of shm-pin batches for get replies in flight to this
+        # worker; drained by its get_ack frames, or by death/drain
+        # cleanup (which may run on another thread — hence the lock and
+        # the no_more_pins latch that stops late appends).
+        from collections import deque
+        self.pending_get_pins: deque = deque()
+        self.pin_lock = threading.Lock()
+        self.no_more_pins = False
 
     def send(self, msg) -> bool:
         with self.send_lock:

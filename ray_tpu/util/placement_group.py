@@ -48,9 +48,18 @@ class PlacementGroup:
 
     def wait(self, timeout_seconds: float | None = None) -> bool:
         from .. import api
+        from ..runtime.serialization import RayError
         ready, _ = api.wait([self.ready()], num_returns=1,
                             timeout=timeout_seconds)
-        return bool(ready)
+        if not ready:
+            return False
+        # a group removed while pending seals its marker with an error so
+        # waiters wake — that is NOT a ready group
+        try:
+            api.get(self.ready(), timeout=1)
+        except RayError:
+            return False
+        return True
 
     @property
     def bundle_count(self) -> int:

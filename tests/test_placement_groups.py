@@ -236,3 +236,23 @@ class TestPlacementGroupEdges:
         # serialized: one must start after the other ends (within jitter)
         assert sb >= ea - 0.05 or sa >= eb - 0.05
         remove_placement_group(pg)
+
+    def test_actor_parked_on_pending_pg_fails_on_remove(self, cluster3):
+        """Removing a still-PENDING group must wake actors parked on its
+        ready marker and fail them (reference: actor creation fails when
+        its placement group is removed) — they used to hang forever."""
+        from ray_tpu.runtime.serialization import ActorDiedError, RayError
+        blocker = placement_group([{"CPU": 2}] * 3,
+                                  strategy="STRICT_SPREAD")
+        assert blocker.wait(timeout_seconds=10)
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert not pg.wait(timeout_seconds=0.3)         # pending
+        h = Member.options(placement_group=pg).remote()
+        ref = h.pid.remote()                            # parked with actor
+        remove_placement_group(pg)                      # while PENDING
+        with pytest.raises((ActorDiedError, RayError)):
+            ray_tpu.get(ref, timeout=5)
+        # pg.ready() must raise, not hang
+        with pytest.raises(RayError):
+            ray_tpu.get(pg.ready(), timeout=5)
+        remove_placement_group(blocker)
