@@ -1,0 +1,63 @@
+"""Pull cost-model benchmark: device pull-source decisions at shuffle scale.
+
+BASELINE config #4 ("object-store pull-manager locality scheduling"): the
+PullManager's transfer-source selection evaluated as one dense device
+computation over the node-bandwidth matrix (ops/pull_kernel.py), checked
+bit-for-bit against the numpy oracle.  The driver records bench.py (the
+north-star metric); this sibling prints the object-plane row for the
+record.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_NODES = 1000
+N_REQUESTS = 100_000
+REPS = 20
+
+
+def main():
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import choose_sources, choose_sources_oracle
+
+    rng = np.random.default_rng(0)
+    loc = rng.random((N_REQUESTS, N_NODES)) < 0.02      # ~20 copies/object
+    bw = rng.integers(100, 100_000,
+                      size=(N_NODES, N_NODES)).astype(np.int32)
+    dest = rng.integers(0, N_NODES, size=N_REQUESTS).astype(np.int32)
+    sizes = rng.integers(1, 1 << 20, size=N_REQUESTS).astype(np.int32)
+
+    d_loc, d_bw = jnp.asarray(loc), jnp.asarray(bw)
+    d_dest, d_sizes = jnp.asarray(dest), jnp.asarray(sizes)
+    src_dev, cost_dev = (np.asarray(x) for x in
+                         choose_sources(d_loc, d_bw, d_dest, d_sizes))
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        s, c = choose_sources(d_loc, d_bw, d_dest, d_sizes)
+        np.asarray(s)
+        times.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(times, 50))
+
+    want_src, want_cost = choose_sources_oracle(loc, bw, dest, sizes)
+    parity = bool((src_dev == want_src).all()
+                  and (cost_dev == want_cost).all())
+
+    print(json.dumps({
+        "metric": f"p50 pull-source decisions: {N_REQUESTS} requests x "
+                  f"{N_NODES}-node bandwidth matrix, device vs oracle "
+                  + ("bit-exact" if parity else "[PARITY FAIL]"),
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(N_REQUESTS / p50 / 1000, 1),  # k-decisions/ms
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,7 +70,18 @@ class DriverRuntime:
 
     # -- API ----------------------------------------------------------------
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
-        return self.store.get([r.id for r in refs], timeout)
+        from .runtime.object_store import GetTimeoutError
+        from .runtime.pull_manager import PullPriority
+        oids = [r.id for r in refs]
+        # locality: remote plasma objects pull to the driver's node first
+        # (reference: a driver get goes through the local plasma store +
+        # PullManager at get priority)
+        if not self.cluster.pull_manager.pull_blocking(
+                oids, self.raylet.row, PullPriority.GET, timeout,
+                self.store):
+            raise GetTimeoutError(
+                f"get timed out; objects not ready within {timeout}s")
+        return self.store.get(oids, timeout)
 
     def put(self, value) -> ObjectRef:
         with self._put_lock:
@@ -81,6 +92,7 @@ class DriverRuntime:
         # size-routed like the reference: large serialized payloads seal
         # into the shared arena; small values stay in-band
         self.store.put_value(oid, value, serialize(value))
+        self.cluster.register_location(oid, self.raylet.row)
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):

@@ -20,14 +20,24 @@ import tempfile
 import threading
 import uuid
 
+import numpy as np
+
 from .common.config import get_config
 from .common.ids import NodeID
 from .common.resources import NodeResources
-from .runtime.object_store import MemoryStore
+from .runtime.object_directory import ObjectDirectory
+from .runtime.object_store import MemoryStore, ObjectLostError
 from .runtime.placement_group_manager import PlacementGroupManager
+from .runtime.pull_manager import PullManager
 from .runtime.raylet import Raylet
 from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
+
+# default simulated link rates (MB/s): same-node "transfers" are free;
+# inter-node defaults to a 10 GB/s ICI-class link until overridden via
+# set_node_bandwidth
+LOCAL_BW_MBPS = 1_000_000
+DEFAULT_BW_MBPS = 10_000
 
 
 def reap_stale_arenas(shm_dir: str = "/dev/shm") -> int:
@@ -91,6 +101,11 @@ class Cluster:
         self.raylets: dict[int, Raylet] = {}  # row -> raylet
         self.actor_manager = None             # attached by the runtime
         self.pg_manager = PlacementGroupManager(self)
+        self.directory = ObjectDirectory()
+        # node-bandwidth matrix (MB/s) — the pull cost model's input;
+        # grows with the CRM row space
+        self.bandwidth_mbps = np.zeros((0, 0), dtype=np.int32)
+        self.pull_manager = PullManager(self)
         self._head_row: int | None = None
 
     # -- topology -----------------------------------------------------------
@@ -103,6 +118,7 @@ class Cluster:
         with self._lock:
             row = self.crm.add_node(node_id,
                                     NodeResources(resources, labels))
+            self._grow_bandwidth(row + 1)
             raylet = Raylet(node_id, self, num_workers)
             raylet.actor_manager = self.actor_manager
             self.raylets[row] = raylet
@@ -120,16 +136,52 @@ class Cluster:
             r._notify_dirty()
         return node_id
 
+    def _grow_bandwidth(self, n: int) -> None:
+        """Extend the bandwidth matrix to cover ``n`` rows (caller holds
+        the lock)."""
+        old = self.bandwidth_mbps.shape[0]
+        if n <= old:
+            return
+        bw = np.full((n, n), DEFAULT_BW_MBPS, dtype=np.int32)
+        np.fill_diagonal(bw, LOCAL_BW_MBPS)
+        bw[:old, :old] = self.bandwidth_mbps
+        self.bandwidth_mbps = bw
+
+    def set_node_bandwidth(self, src_row: int, dst_row: int,
+                           mbps: int, symmetric: bool = True) -> None:
+        """Override a link rate in the pull cost model (tests/operators)."""
+        with self._lock:
+            self.bandwidth_mbps[src_row, dst_row] = mbps
+            if symmetric:
+                self.bandwidth_mbps[dst_row, src_row] = mbps
+
+    def register_location(self, oid, row: int) -> None:
+        """Record that a freshly sealed plasma-routed object was born on
+        ``row`` (in-band values have no locations — they ship with specs)."""
+        kind, _ = self.store.plasma_info(oid)
+        if kind in ("shm", "spill"):
+            self.directory.add_location(oid, row)
+
     def remove_node(self, node_id: NodeID) -> None:
         """Simulate node death: resources vanish, running tasks retried
         elsewhere (or failed), queued tasks re-routed, actors restarted or
-        declared dead (SURVEY §5.3 failure semantics)."""
+        declared dead, plasma objects whose only copy lived there are LOST
+        (SURVEY §5.3 failure semantics)."""
         with self._lock:
             row = self.crm.row_of(node_id)
             if row is None or row == self._head_row:
                 raise ValueError("cannot remove head node or unknown node")
             raylet = self.raylets.pop(row)
             self.crm.remove_node(node_id)
+        lost = self.directory.on_node_removed(row)
+        self.pull_manager.on_objects_lost(lost)
+        from .runtime.serialization import RayTaskError
+        for oid in lost:
+            self.store.poison(oid, RayTaskError(
+                "object", f"object {oid.hex()[:12]} is lost: the node "
+                "holding its only copy died", ObjectLostError(
+                    f"object {oid.hex()[:12]} lost with node "
+                    f"{node_id.hex()[:12]}")))
         self.pg_manager.on_node_removed(row)
         raylet.drain_for_removal(self.head())
 
@@ -153,6 +205,7 @@ class Cluster:
     # -- teardown -----------------------------------------------------------
     def stop(self) -> None:
         self.pg_manager.shutdown()
+        self.pull_manager.shutdown()
         with self._lock:
             raylets = list(self.raylets.values())
             self.raylets.clear()

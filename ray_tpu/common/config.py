@@ -83,7 +83,22 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "pull_manager_max_inflight_mb": (
         int, 256,
         "Receiver-driven pull quota (reference PullManager active-pull "
-        "memory cap)."),
+        "memory cap): queued pulls activate only while in-flight bytes "
+        "stay under this."),
+    "pull_transfer_sim_gbps": (
+        float, 0.0,
+        "Simulated link rate for pull transfers in the in-process "
+        "cluster; 0 = instantaneous (directory update only)."),
+    "pull_device_batch_min": (
+        int, 128,
+        "Minimum activation batch routed to the device pull-source "
+        "kernel; smaller batches use the bit-identical numpy oracle."),
+    "locality_aware_scheduling": (
+        bool, True,
+        "Prefer placing default-strategy tasks on the node holding the "
+        "most bytes of their plasma args (reference: locality-aware "
+        "lease targeting), falling back to hybrid when that node is "
+        "busy."),
     "max_direct_call_object_size": (
         int, 100 * 1024,
         "Results at or below this many bytes return in-band to the owner's "

@@ -1,5 +1,8 @@
 from .bundle_kernel import schedule_bundle_groups, schedule_bundle_groups_np
 from .hybrid_kernel import schedule_grouped, schedule_grouped_np
+from .pull_kernel import (choose_sources, choose_sources_np,
+                          choose_sources_oracle)
 
 __all__ = ["schedule_bundle_groups", "schedule_bundle_groups_np",
-           "schedule_grouped", "schedule_grouped_np"]
+           "schedule_grouped", "schedule_grouped_np",
+           "choose_sources", "choose_sources_np", "choose_sources_oracle"]

@@ -1,0 +1,86 @@
+"""Device pull-source selection: the PullManager's bandwidth cost model.
+
+Reference parity: upstream's ``PullManager`` (``src/ray/object_manager/
+pull_manager.cc``) prioritizes pull requests and picks transfer sources
+against per-link cost/bandwidth accounting — the component BASELINE.json's
+north star singles out: "the Plasma object store's pull-manager cost model
+... reuse[s] the same device-resident node-bandwidth matrix" (SURVEY.md §1
+layer 6, §3.3; mount empty).
+
+TPU-first formulation: one batch of R pending pull requests is a dense
+computation over the (N x N) node-bandwidth matrix resident in HBM —
+
+    eff[r, n]  = loc[r, n] ? bw[n, dest[r]] : 0
+    src[r]     = argmax_n eff[r, n]        (first max -> deterministic)
+    cost[r]    = size_kb[r] // bw[src[r], dest[r]]   (~ transfer ms)
+
+instead of a per-request host loop over object locations.  All arithmetic
+is int32 (sizes in KB, bandwidth in MB/s, cost in ~ms), so CPU and TPU
+agree bit-for-bit with the numpy oracle below.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NO_SOURCE_COST = np.int32(2**31 - 1)
+
+
+@jax.jit
+def choose_sources(loc, bw, dest, sizes_kb):
+    """Pick the best transfer source for each pull request, on device.
+
+    loc: (R, N) bool — which nodes hold a copy of each object.
+    bw: (N, N) int32 — bandwidth in MB/s, ``bw[src, dst]``.
+    dest: (R,) int32 — requesting node row per request.
+    sizes_kb: (R,) int32 — object size in KB.
+
+    Returns (src (R,) int32, cost (R,) int32): ``src = -1`` when no node
+    holds the object; cost ~ transfer milliseconds (KB // MB/s), used for
+    activation ordering.  Deterministic: ties break to the lowest row.
+    """
+    bw_to_dest = bw[:, dest].T                      # (R, N)
+    eff = jnp.where(loc, bw_to_dest, 0)
+    src = jnp.argmax(eff, axis=1).astype(jnp.int32)
+    best = jnp.take_along_axis(eff, src[:, None], axis=1)[:, 0]
+    cost = jnp.where(best > 0, sizes_kb // jnp.maximum(best, 1),
+                     _NO_SOURCE_COST)
+    return jnp.where(best > 0, src, -1), cost
+
+
+def choose_sources_oracle(loc: np.ndarray, bw: np.ndarray, dest: np.ndarray,
+                          sizes_kb: np.ndarray
+                          ) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy oracle — bit-identical to ``choose_sources``."""
+    loc = np.asarray(loc, dtype=bool)
+    bw = np.asarray(bw, dtype=np.int32)
+    dest = np.asarray(dest, dtype=np.int32)
+    sizes_kb = np.asarray(sizes_kb, dtype=np.int32)
+    eff = np.where(loc, bw[:, dest].T, 0).astype(np.int32)
+    src = eff.argmax(axis=1).astype(np.int32)
+    best = np.take_along_axis(eff, src[:, None], axis=1)[:, 0]
+    cost = np.where(best > 0, sizes_kb // np.maximum(best, 1),
+                    _NO_SOURCE_COST).astype(np.int32)
+    return np.where(best > 0, src, -1).astype(np.int32), cost
+
+
+def choose_sources_np(loc, bw, dest, sizes_kb):
+    """Host wrapper for the device kernel: pads the request axis to a
+    power-of-2 bucket (avoids a fresh XLA compile per batch size) and
+    returns numpy arrays."""
+    loc = np.asarray(loc, dtype=bool)
+    r = loc.shape[0]
+    rp = max(8, 1 << (r - 1).bit_length())
+    n = loc.shape[1]
+    loc_p = np.zeros((rp, n), dtype=bool)
+    loc_p[:r] = loc
+    dest_p = np.zeros(rp, dtype=np.int32)
+    dest_p[:r] = dest
+    sizes_p = np.zeros(rp, dtype=np.int32)
+    sizes_p[:r] = sizes_kb
+    src, cost = choose_sources(
+        jnp.asarray(loc_p), jnp.asarray(bw, dtype=jnp.int32),
+        jnp.asarray(dest_p), jnp.asarray(sizes_p))
+    return np.asarray(src)[:r], np.asarray(cost)[:r]

@@ -5,9 +5,12 @@ object store, core worker, Python API).  Device math lives in ray_tpu/ops;
 everything here is host-side orchestration around it.
 """
 
+from .object_directory import ObjectDirectory
 from .object_ref import ObjectRef
 from .object_store import MemoryStore, ObjectLostError, GetTimeoutError
+from .pull_manager import PullManager, PullPriority
 from .serialization import RayTaskError, WorkerCrashedError
 
-__all__ = ["ObjectRef", "MemoryStore", "ObjectLostError", "GetTimeoutError",
-           "RayTaskError", "WorkerCrashedError"]
+__all__ = ["ObjectDirectory", "ObjectRef", "MemoryStore", "ObjectLostError",
+           "GetTimeoutError", "PullManager", "PullPriority", "RayTaskError",
+           "WorkerCrashedError"]

@@ -294,10 +294,12 @@ class ActorManager:
             if call is None:
                 return True
             if kind == "actor_result":
+                row = rec.row if rec is not None else -1
                 for i, data in enumerate(msg[2]):
-                    self._store.put_serialized(
-                        ObjectID.for_task_return(call.task_id, i + 1),
-                        data)
+                    oid = ObjectID.for_task_return(call.task_id, i + 1)
+                    self._store.put_serialized(oid, data)
+                    if row >= 0:
+                        self._cluster.register_location(oid, row)
             else:
                 err = deserialize(msg[2])
                 for i in range(call.num_returns):

@@ -276,6 +276,36 @@ class MemoryStore:
             except OSError:
                 pass
 
+    def plasma_info(self, object_id: ObjectID) -> tuple[str | None, int]:
+        """(kind, size): kind is "shm" | "spill" (plasma-routed, has
+        directory locations), "inband" (ships with specs), or None
+        (absent)."""
+        with self._cv:
+            e = self._objects.get(object_id)
+            if isinstance(e, ShmEntry):
+                return "shm", e.size
+            if isinstance(e, SpillEntry):
+                return "spill", e.size
+            return (None, 0) if e is None else ("inband", 0)
+
+    def poison(self, object_id: ObjectID, error) -> None:
+        """Replace a LOST object's entry with an in-band error value so
+        every current and future reader surfaces the loss instead of
+        hanging (reference: lost plasma objects raise ObjectLostError).
+        The only sanctioned break of seal-once immutability; pinned
+        blocks park as zombies until their descriptors release."""
+        with self._cv:
+            entry = self._objects.get(object_id)
+            if isinstance(entry, ShmEntry) and entry.pins > 0:
+                self._zombies[(object_id, entry.offset)] = entry
+            else:
+                self._release_entry(entry)
+            self._objects[object_id] = error
+            listeners = self._listeners.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
     # -- materialization ----------------------------------------------------
     def _value_locked(self, object_id: ObjectID):
         """Deserialize/restore an entry into a Python value; touches LRU."""
@@ -408,6 +438,20 @@ class MemoryStore:
                 self._listeners.setdefault(object_id, []).append(callback)
                 return
         callback(object_id)
+
+    def cancel_on_ready(self, object_id: ObjectID, callback) -> None:
+        """Deregister a pending ``on_ready`` listener (no-op if it already
+        fired or was never registered) — abandoning waiters must not leak
+        closures."""
+        with self._cv:
+            lst = self._listeners.get(object_id)
+            if lst is not None:
+                try:
+                    lst.remove(callback)
+                except ValueError:
+                    return
+                if not lst:
+                    del self._listeners[object_id]
 
     # -- introspection ------------------------------------------------------
     def size(self) -> int:

@@ -1,0 +1,272 @@
+"""PullManager: receiver-driven object transfer with a bandwidth cost model.
+
+Reference parity: upstream's ``PullManager`` (``src/ray/object_manager/
+pull_manager.cc``) — pull requests prioritized get > wait > task-arg,
+activated under an in-flight memory quota, sources chosen against
+per-link cost accounting; ``ObjectManager`` push/pull moves the chunks
+(SURVEY.md §1 layer 6, §3.3 — the cost model BASELINE.json's north star
+names explicitly; mount empty).
+
+TPU-first: source selection for an activation batch is one dense device
+computation over the node-bandwidth matrix (``ops/pull_kernel.py``) —
+the matrix lives in HBM next to the scheduler state; small batches use
+the bit-identical numpy oracle (same backend-switch pattern as the
+scheduler, invisible to callers).
+
+The simulated-cluster form (one shared arena, like upstream's
+``cluster_utils.Cluster`` on one machine) makes a "transfer" a directory
+update + byte accounting, optionally paced by a simulated link rate
+(``pull_transfer_sim_gbps``) so quota/backpressure behavior is testable.
+"""
+
+from __future__ import annotations
+
+import enum
+import heapq
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..common.config import get_config
+from ..common.ids import ObjectID
+
+
+class PullPriority(enum.IntEnum):
+    """Activation order (reference: get > wait > task arg)."""
+    GET = 0
+    WAIT = 1
+    TASK_ARG = 2
+
+
+class PullManager:
+    def __init__(self, cluster):
+        self._cluster = cluster
+        cfg = get_config()
+        self._quota = cfg.pull_manager_max_inflight_mb * (1 << 20)
+        self._sim_gbps = cfg.pull_transfer_sim_gbps
+        self._device_min = cfg.pull_device_batch_min
+        self._cv = threading.Condition()
+        # pending requests: key (oid, dest_row) -> state dict
+        self._requests: dict[tuple, dict] = {}
+        self._heap: list = []               # (priority, seq, key)
+        self._seq = 0
+        self._active: deque = deque()       # (key, src_row) awaiting transfer
+        self._inflight_bytes = 0
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        # stats
+        self.num_pulls = 0
+        self.bytes_pulled = 0
+        self.num_failed = 0
+        self.device_batches = 0
+        self.oracle_batches = 0
+
+    # -- request side --------------------------------------------------------
+    def request_pull(self, object_id: ObjectID, size: int, dest_row: int,
+                     priority: PullPriority,
+                     callback=None) -> bool:
+        """Ask for a copy of ``object_id`` at ``dest_row``.  Returns True
+        if already satisfied (no pull needed); otherwise queues and later
+        invokes ``callback(ok: bool)`` (ok=False when the object is lost).
+        Requests for the same (object, dest) coalesce."""
+        directory = self._cluster.directory
+        if directory.has_location(object_id, dest_row) or \
+                not directory.is_tracked(object_id):
+            # local already, or not a plasma object (in-band values ship
+            # with specs; poisoned/lost entries are in-band errors)
+            if callback is not None:
+                callback(True)
+            return True
+        key = (object_id, dest_row)
+        with self._cv:
+            req = self._requests.get(key)
+            if req is not None:
+                if callback is not None:
+                    req["callbacks"].append(callback)
+                # escalate priority if a stronger waiter arrives
+                if priority < req["priority"] and not req["active"]:
+                    req["priority"] = priority
+                    self._seq += 1
+                    heapq.heappush(self._heap,
+                                   (int(priority), self._seq, key))
+                return False
+            self._seq += 1
+            self._requests[key] = {
+                "size": max(int(size), 1),
+                "priority": priority,
+                "callbacks": [callback] if callback is not None else [],
+                "active": False,
+            }
+            heapq.heappush(self._heap, (int(priority), self._seq, key))
+            self._ensure_thread_locked()
+            self._activate_locked()
+        return False
+
+    def pull_blocking(self, object_ids, dest_row: int,
+                      priority: PullPriority, timeout: float | None,
+                      store) -> bool:
+        """Wait until every object exists AND is local to ``dest_row``
+        (pulling as needed).  Lost objects count as done — their poisoned
+        in-band error surfaces at the subsequent get.  False on timeout."""
+        state = {"left": len(object_ids)}
+        done = threading.Event()
+        lock = threading.Lock()
+        if not object_ids:
+            return True
+
+        def one_done(_ok: bool) -> None:
+            with lock:
+                state["left"] -= 1
+                if state["left"] == 0:
+                    done.set()
+
+        def on_present(oid):
+            kind, size = store.plasma_info(oid)
+            if kind in ("shm", "spill"):
+                self.request_pull(oid, size, dest_row, priority,
+                                  callback=one_done)
+            else:
+                one_done(True)
+
+        for oid in object_ids:
+            store.on_ready(oid, on_present)
+        if done.wait(timeout):
+            return True
+        # timed out: deregister presence listeners so abandoned gets do
+        # not leak closures (or fire phantom pulls later)
+        for oid in object_ids:
+            store.cancel_on_ready(oid, on_present)
+        return False
+
+    # -- activation (quota + source selection) -------------------------------
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._transfer_loop,
+                                            daemon=True, name="pull-manager")
+            self._thread.start()
+
+    def _activate_locked(self) -> None:
+        """Move queued requests into the active transfer set while the
+        in-flight byte quota allows; pick sources for the whole batch in
+        one cost-model evaluation (device kernel for large batches)."""
+        batch_keys = []
+        while self._heap:
+            prio, _seq, key = self._heap[0]
+            req = self._requests.get(key)
+            if req is None or req["active"] or prio > req["priority"]:
+                heapq.heappop(self._heap)       # stale heap entry
+                continue
+            if self._inflight_bytes + req["size"] > self._quota and \
+                    self._inflight_bytes > 0:
+                break                           # quota: wait for completions
+            heapq.heappop(self._heap)
+            req["active"] = True
+            self._inflight_bytes += req["size"]
+            batch_keys.append(key)
+        if not batch_keys:
+            return
+        srcs = self._choose_sources(batch_keys)
+        for key, src in zip(batch_keys, srcs):
+            if src < 0:
+                # no live copy anywhere: the object is lost
+                self._fail_locked(key)
+                continue
+            self._active.append((key, int(src)))
+        self._cv.notify_all()
+
+    def _choose_sources(self, keys: list[tuple]) -> np.ndarray:
+        """Best source per request via the bandwidth cost model."""
+        directory = self._cluster.directory
+        bw = self._cluster.bandwidth_mbps
+        n = bw.shape[0]
+        oids = [k[0] for k in keys]
+        dest = np.array([k[1] for k in keys], dtype=np.int32)
+        sizes_kb = np.array(
+            [max(self._requests[k]["size"] >> 10, 1) for k in keys],
+            dtype=np.int32)
+        loc = directory.location_matrix(oids, n)
+        if len(keys) >= self._device_min:
+            from ..ops.pull_kernel import choose_sources_np
+            self.device_batches += 1
+            src, _cost = choose_sources_np(loc, bw, dest, sizes_kb)
+        else:
+            from ..ops.pull_kernel import choose_sources_oracle
+            self.oracle_batches += 1
+            src, _cost = choose_sources_oracle(loc, bw, dest, sizes_kb)
+        return src
+
+    def _fail_locked(self, key: tuple) -> None:
+        req = self._requests.pop(key, None)
+        if req is None:
+            return
+        if req["active"]:
+            self._inflight_bytes -= req["size"]
+        self.num_failed += 1
+        cbs = req["callbacks"]
+        if cbs:
+            # callbacks run without the lock held (they may re-enter)
+            threading.Thread(target=lambda: [cb(False) for cb in cbs],
+                             daemon=True).start()
+
+    # -- transfer loop -------------------------------------------------------
+    def _transfer_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._active:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                key, src = self._active.popleft()
+                # CLAIM the request while still holding the lock: a
+                # concurrent on_objects_lost can no longer fail it, so
+                # completion happens exactly once
+                req = self._requests.pop(key, None)
+            if req is None:
+                continue
+            if self._sim_gbps > 0:
+                time.sleep(req["size"] / (self._sim_gbps * 1e9))
+            oid, dest = key
+            # the object may have been lost mid-transfer (source node
+            # died): a lost object is untracked — do not resurrect it
+            ok = self._cluster.directory.is_tracked(oid)
+            if ok:
+                self._cluster.directory.add_location(oid, dest)
+            with self._cv:
+                self._inflight_bytes -= req["size"]
+                if ok:
+                    self.num_pulls += 1
+                    self.bytes_pulled += req["size"]
+                else:
+                    self.num_failed += 1
+                self._activate_locked()
+            for cb in req["callbacks"]:
+                cb(ok)
+
+    # -- loss / teardown -----------------------------------------------------
+    def on_objects_lost(self, object_ids) -> None:
+        lost = set(object_ids)
+        with self._cv:
+            for key in [k for k in self._requests if k[0] in lost]:
+                self._fail_locked(key)
+            self._active = deque((k, s) for k, s in self._active
+                                 if k[0] not in lost)
+            self._activate_locked()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "num_pulls": self.num_pulls,
+                "bytes_pulled": self.bytes_pulled,
+                "num_failed": self.num_failed,
+                "queued": len(self._requests),
+                "inflight_bytes": self._inflight_bytes,
+                "device_batches": self.device_batches,
+                "oracle_batches": self.oracle_batches,
+            }
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()

@@ -53,6 +53,7 @@ class Raylet:
         self._local_queue: deque[TaskID] = deque()  # placed here, await dispatch
         self._planned_cu = None     # dense planned-load vector (lazy width)
         self._waiting: dict[TaskID, int] = {}   # task -> missing dep count
+        self._pull_pending: dict[TaskID, int] = {}  # task -> in-flight pulls
         # task_id_bin -> (TaskID, WorkerHandle, pinned shm-arg batch)
         self._running: dict[bytes, tuple[TaskID, WorkerHandle, list]] = {}
         self._stopped = False
@@ -96,14 +97,45 @@ class Raylet:
         then the task waits in LocalTaskManager for workers/resources —
         it is not re-scheduled on every worker event).  The planned load
         is visible to subsequent scheduling rounds so they do not
-        over-assign this node."""
+        over-assign this node.  Plasma args not yet local are pulled at
+        task-arg priority; dispatch waits for the copies (reference:
+        DependencyManager asks the PullManager for task args)."""
         rec = self.task_manager.get(task_id)
+        pulls = []
+        if rec is not None:
+            for a in rec.spec.args:
+                if isinstance(a, ObjectRef):
+                    kind, size = self.store.plasma_info(a.id)
+                    if kind in ("shm", "spill") and \
+                            not self.cluster.directory.has_location(
+                                a.id, self.row):
+                        pulls.append((a.id, size))
         with self._cv:
             if rec is not None:
                 self._planned_add(rec.spec.resources, 1)
+            if pulls:
+                self._pull_pending[task_id] = len(pulls)
             self._local_queue.append(task_id)
             self._dirty = True
             self._cv.notify_all()
+        if pulls:
+            from .pull_manager import PullPriority
+            for oid, size in pulls:
+                self.cluster.pull_manager.request_pull(
+                    oid, size, self.row, PullPriority.TASK_ARG,
+                    callback=lambda _ok, t=task_id: self._pull_done(t))
+
+    def _pull_done(self, task_id: TaskID) -> None:
+        with self._cv:
+            left = self._pull_pending.get(task_id)
+            if left is None:
+                return
+            if left <= 1:
+                del self._pull_pending[task_id]
+                self._dirty = True
+                self._cv.notify_all()
+            else:
+                self._pull_pending[task_id] = left - 1
 
     def _planned_add(self, resources, sign: int) -> None:
         # caller holds _cv
@@ -199,10 +231,15 @@ class Raylet:
         """
         cfg = get_config()
         specs = [rec.spec for rec in batch]
-        uniform = all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
-                      for s in specs)
-        if cfg.scheduler_device_backend and uniform and \
-                len(batch) >= cfg.scheduler_device_batch_min:
+        # device path only for large uniform default-strategy batches with
+        # no locality signal; the locality probe (store+directory locks per
+        # arg) runs only when the batch is otherwise device-eligible —
+        # the host path computes it once per spec inside _options_for
+        if cfg.scheduler_device_backend and \
+                len(batch) >= cfg.scheduler_device_batch_min and \
+                all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
+                    for s in specs) and \
+                all(self._locality_row(s) is None for s in specs):
             return self._schedule_rows_device(specs)
         # per-task CPU policy on a snapshot (sequential within the round),
         # partitioned by scheduling class in first-appearance order — the
@@ -295,8 +332,36 @@ class Raylet:
             ).clip(-(2**30), 2**30).astype(np.int32)
         return snapshot
 
+    def _locality_row(self, spec) -> int | None:
+        """Node row holding the most bytes of the spec's plasma args, or
+        None when locality gives no signal (no plasma args, or the knob
+        is off).  Reference: the core worker's locality-aware lease
+        policy targets the raylet with the most object bytes local."""
+        if not spec.args or not get_config().locality_aware_scheduling:
+            return None
+        by_row: dict[int, int] = {}
+        for a in spec.args:
+            if isinstance(a, ObjectRef):
+                kind, size = self.store.plasma_info(a.id)
+                if kind in ("shm", "spill"):
+                    for r in self.cluster.directory.locations(a.id):
+                        by_row[r] = by_row.get(r, 0) + size
+        if not by_row:
+            return None
+        # max bytes, lowest row on ties (deterministic)
+        return min(by_row, key=lambda r: (-by_row[r], r))
+
     def _options_for(self, spec, n_rows: int) -> SchedulingOptions:
         kind = spec.strategy.kind
+        if kind is SchedulingStrategyKind.DEFAULT:
+            row = self._locality_row(spec)
+            if row is not None:
+                # soft affinity: land on the max-local-bytes node when it
+                # can take the task, hybrid otherwise
+                return SchedulingOptions(
+                    scheduling_type=SchedulingType.NODE_AFFINITY,
+                    node_row=row, soft=True)
+            return SchedulingOptions()
         if kind is SchedulingStrategyKind.SPREAD:
             return SchedulingOptions(scheduling_type=SchedulingType.SPREAD)
         if kind is SchedulingStrategyKind.NODE_AFFINITY:
@@ -376,6 +441,10 @@ class Raylet:
                         self._planned_add(rec.spec.resources, -1)
                 continue
             spec = rec.spec
+            with self._cv:
+                if task_id in self._pull_pending:
+                    scanned += 1        # args still in flight: skip
+                    continue
             if spec.resources.key() in failed_classes:
                 scanned += 1
                 continue
@@ -528,6 +597,8 @@ class Raylet:
                         # size-routed: large payloads seal into the shared
                         # arena (zero-copy reads), small ones in-band
                         self.store.put_serialized(oid, data)
+                        # plasma-routed results are born on this node
+                        self.cluster.register_location(oid, self.row)
                 else:
                     err = deserialize(msg[2])
                     for oid in rec.return_ids:
@@ -540,18 +611,24 @@ class Raylet:
             timeout = msg[2] if len(msg) > 2 else None
             # descriptors: shm objects reply as (offset, size) for a
             # zero-copy read on the worker's own arena mapping
-            if all(self.store.contains(o) for o in oids):
+            if all(self.store.contains(o) for o in oids) and \
+                    all(self._object_local(o) for o in oids):
                 descs = self.store.get_descriptors_blocking(oids)
                 self._send_get_reply(worker, oids, descs)
                 return
             # Blocking get: release the task's resources while the worker
             # waits (reference: CPU is returned during ray.get so dependent
             # tasks can run) and grow the pool if it is starved — otherwise
-            # recursive fan-out deadlocks on worker slots.
+            # recursive fan-out deadlocks on worker slots.  Remote plasma
+            # objects are pulled here at GET priority (reference:
+            # PullManager prioritizes gets above wait/task-arg pulls).
+            from .pull_manager import PullPriority
             rec = self._rec_of_worker(worker)
             self._enter_blocked(worker, rec)
-            descs = self.store.get_descriptors_blocking(oids,
-                                                        timeout=timeout)
+            pulled = self.cluster.pull_manager.pull_blocking(
+                oids, self.row, PullPriority.GET, timeout, self.store)
+            descs = self.store.get_descriptors_blocking(
+                oids, timeout=timeout) if pulled else None
             self._exit_blocked(worker, rec)
             if descs is None:
                 worker.send(("get_reply", serialize(("timeout", None))))
@@ -578,10 +655,21 @@ class Raylet:
                 ready, _ = self.store.wait(oids, num_returns,
                                            timeout=timeout)
                 self._exit_blocked(worker, rec)
+            # warm locality for satisfied waits (reference: wait triggers
+            # pulls below get priority); readiness itself is presence-based
+            from .pull_manager import PullPriority
+            for o in ready:
+                if not self._object_local(o):
+                    kind, size = self.store.plasma_info(o)
+                    if kind in ("shm", "spill"):
+                        self.cluster.pull_manager.request_pull(
+                            o, size, self.row, PullPriority.WAIT)
             worker.send(("wait_reply",
                          serialize([o.binary() for o in ready])))
         elif kind == "put":
-            self.store.put_serialized(self._oid(msg[1]), msg[2])
+            oid = self._oid(msg[1])
+            self.store.put_serialized(oid, msg[2])
+            self.cluster.register_location(oid, self.row)
         elif kind == "submit":
             spec = deserialize(msg[1])
             fn_id, fn_bytes = msg[2], msg[3]
@@ -625,6 +713,13 @@ class Raylet:
     def _oid(binary: bytes):
         from ..common.ids import ObjectID
         return ObjectID(binary)
+
+    def _object_local(self, oid) -> bool:
+        """True when a get/dispatch on this node needs no pull: in-band
+        value, or a plasma object with a local copy."""
+        kind, _ = self.store.plasma_info(oid)
+        return kind not in ("shm", "spill") or \
+            self.cluster.directory.has_location(oid, self.row)
 
     def _drain_worker_pins(self, worker: WorkerHandle) -> None:
         """Release every un-acked get-reply pin of a dead/draining worker
