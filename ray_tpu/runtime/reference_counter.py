@@ -1,0 +1,132 @@
+"""Owner-side reference counting: out-of-scope objects are reclaimed.
+
+Reference parity: the core worker's ``ReferenceCounter`` (``src/ray/
+core_worker/reference_count.cc``) tracks local refs per ObjectRef (Python
+``__del__``/pickle hooks) plus submitted-task dependencies, and drives
+object deletion when counts hit zero; lineage stays pinned while
+reconstruction might need it (SURVEY.md §1 layer 7, §5.3; mount empty).
+
+In-process form: the driver is the owner of every object, so one counter
+covers the cluster.  Task-arg borrows need no protocol — the retained
+``TaskSpec`` in the TaskManager holds the arg ObjectRefs (strong Python
+references), so an in-flight or lineage-pinned task keeps its deps alive
+and eviction of lineage cascades naturally through ``__del__``.
+
+``__del__`` safety: ref events are appended to a lock-free deque (atomic
+in CPython) and folded by a dedicated reclaimer thread — ``__del__`` can
+fire at any allocation point, including inside store/raylet critical
+sections, so it must never take foreign locks.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..common.ids import ObjectID
+
+
+class ReferenceCounter:
+    def __init__(self):
+        self._events: deque = deque()       # (+1 | -1, ObjectID)
+        self._wake = threading.Event()
+        self._counts: dict[ObjectID, int] = {}
+        self._zero: set[ObjectID] = set()   # count hit 0, awaiting seal
+        self._pinned: set[ObjectID] = set()
+        self._reclaim = None                # callback(oid): free the object
+        self._contains = None               # callback(oid) -> bool (sealed?)
+        self._on_ready = None               # store.on_ready registration
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # -- hot path (any thread, __del__-safe: no locks) -----------------------
+    def incref(self, object_id: ObjectID) -> None:
+        self._events.append((1, object_id))
+
+    def decref(self, object_id: ObjectID) -> None:
+        self._events.append((-1, object_id))
+        self._wake.set()
+
+    # -- pinning (PG ready markers etc. are never reclaimed) -----------------
+    def pin(self, object_id: ObjectID) -> None:
+        self._events.append((0, object_id))
+
+    def unpin(self, object_id: ObjectID) -> None:
+        self._events.append((2, object_id))
+        self._wake.set()
+
+    # -- lifecycle -----------------------------------------------------------
+    def attach(self, reclaim, contains, on_ready) -> None:
+        """Start the reclaimer: ``reclaim(oid)`` frees a dead object,
+        ``contains(oid)`` tests sealed-ness, ``on_ready(oid, cb)`` defers
+        reclamation of not-yet-sealed objects."""
+        self._reclaim = reclaim
+        self._contains = contains
+        self._on_ready = on_ready
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ref-counter")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+    # -- reclaimer thread ----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            self.flush()
+
+    def flush(self) -> None:
+        """Fold queued events and reclaim newly dead objects.  Runs on the
+        reclaimer thread (tests may call it directly for determinism)."""
+        dead = []
+        while True:
+            try:
+                delta, oid = self._events.popleft()
+            except IndexError:
+                break
+            if delta == 0:
+                self._pinned.add(oid)
+                continue
+            if delta == 2:
+                self._pinned.discard(oid)
+                if self._counts.get(oid, 0) <= 0:
+                    dead.append(oid)
+                continue
+            c = self._counts.get(oid, 0) + delta
+            if c > 0:
+                self._counts[oid] = c
+                self._zero.discard(oid)
+            else:
+                self._counts.pop(oid, None)
+                dead.append(oid)
+        for oid in dead:
+            if oid in self._pinned or self._counts.get(oid, 0) > 0:
+                continue
+            if self._contains is not None and not self._contains(oid):
+                # unsealed (pending task output): reclaim when it seals,
+                # unless a new reference appears first
+                self._zero.add(oid)
+                if self._on_ready is not None:
+                    self._on_ready(oid, self._reclaim_if_still_dead)
+                continue
+            if self._reclaim is not None:
+                self._reclaim(oid)
+
+    def _reclaim_if_still_dead(self, oid: ObjectID) -> None:
+        if oid in self._zero and oid not in self._pinned \
+                and self._counts.get(oid, 0) <= 0:
+            self._zero.discard(oid)
+            if self._reclaim is not None:
+                self._reclaim(oid)
+
+    # -- introspection -------------------------------------------------------
+    def count_of(self, object_id: ObjectID) -> int:
+        return self._counts.get(object_id, 0)
+
+    def stats(self) -> dict:
+        return {"num_tracked": len(self._counts),
+                "num_pinned": len(self._pinned),
+                "queued_events": len(self._events)}
