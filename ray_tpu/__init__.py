@@ -2,13 +2,14 @@
 
 A ground-up rebuild of the capabilities of the reference
 (``pschafhalter/ray``, a fork of ``ray-project/ray``): dynamic task graph +
-actor runtime, two-level scheduling, placement groups, and a shared-memory
+actor runtime, two-level scheduling, placement groups, a shared-memory
 object store (native C++ arena, zero-copy worker reads, descriptor pinning,
-LRU spill/restore) — with the scheduling data plane evaluated as dense TPU
-computations (JAX/XLA/Pallas) per BASELINE.json's north star.  The
-autoscaler's bin-packing runs on-device; remaining reference subsystems
-(inter-node transfer, lineage recovery, observability) are tracked in
-VERDICT.md and land incrementally.
+LRU spill/restore), an inter-node object plane (directory + pull manager
+with a device-evaluated bandwidth cost model), owner-side reference
+counting with lineage reconstruction, and an autoscaler runtime loop —
+with the scheduling/packing data planes evaluated as dense TPU
+computations (JAX/XLA/Pallas) per BASELINE.json's north star.  Remaining
+reference subsystems are tracked in VERDICT.md and land incrementally.
 
 Public API mirrors the reference's (``ray.init/remote/get/put/wait/...``,
 SURVEY.md §1 layer 9).

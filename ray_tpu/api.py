@@ -241,10 +241,12 @@ class RemoteFunction:
             num_returns=self._num_returns,
             resources=ResourceRequest(res),
             strategy=self._strategy, max_retries=retries)
-        rt.submit_spec(spec, fn_id, fn_bytes)
+        # result refs are created BEFORE submission: the owner's refcount
+        # must never dip to zero while the caller is still building them
         from .common.ids import ObjectID
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
                 for i in range(self._num_returns)]
+        rt.submit_spec(spec, fn_id, fn_bytes)
         return refs[0] if self._num_returns == 1 else refs
 
 

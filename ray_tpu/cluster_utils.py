@@ -26,10 +26,13 @@ from .common.config import get_config
 from .common.ids import NodeID
 from .common.resources import NodeResources
 from .runtime.object_directory import ObjectDirectory
+from .runtime.object_ref import install_counter, uninstall_counter
 from .runtime.object_store import MemoryStore, ObjectLostError
 from .runtime.placement_group_manager import PlacementGroupManager
 from .runtime.pull_manager import PullManager
 from .runtime.raylet import Raylet
+from .runtime.recovery import ObjectRecoveryManager
+from .runtime.reference_counter import ReferenceCounter
 from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
 
@@ -106,7 +109,33 @@ class Cluster:
         # grows with the CRM row space
         self.bandwidth_mbps = np.zeros((0, 0), dtype=np.int32)
         self.pull_manager = PullManager(self)
+        self.recovery = ObjectRecoveryManager(self)
+        # owner-side reference counting: ObjectRefs created in this
+        # (driver) process drive reclamation of out-of-scope objects
+        self.ref_counter = ReferenceCounter()
+        self.ref_counter.attach(self._reclaim_object, self.store.contains,
+                                self.store.on_ready, self._expects_seal)
+        install_counter(self.ref_counter)
+        self.autoscaler = None          # attached by start_autoscaler
+        from .runtime.health import HealthCheckManager
+        self.health = HealthCheckManager(self)
+        self.health.start()
         self._head_row: int | None = None
+
+    def _reclaim_object(self, oid) -> None:
+        """Refcount hit zero cluster-wide: free the object everywhere and
+        release producing-task lineage once all its returns are dead."""
+        self.store.delete([oid])
+        self.directory.drop([oid])
+        self.task_manager.on_return_reclaimed(oid)
+
+    def _expects_seal(self, oid) -> bool:
+        """Will an absent object ever seal?  Only a pending task return
+        can; puts and deleted markers never re-seal."""
+        if oid.is_put():
+            return False
+        rec = self.task_manager.get(oid.task_id())
+        return rec is not None and not rec.done
 
     # -- topology -----------------------------------------------------------
     def add_node(self, resources: dict[str, float] | None = None,
@@ -177,6 +206,11 @@ class Cluster:
         self.pull_manager.on_objects_lost(lost)
         from .runtime.serialization import RayTaskError
         for oid in lost:
+            # lineage first: reconstructable objects re-execute their
+            # producing task and re-seal; only unrecoverable ones poison
+            # (SURVEY §5.3 — reconstruction, else ObjectLostError)
+            if self.recovery.recover(oid):
+                continue
             self.store.poison(oid, RayTaskError(
                 "object", f"object {oid.hex()[:12]} is lost: the node "
                 "holding its only copy died", ObjectLostError(
@@ -184,6 +218,14 @@ class Cluster:
                     f"{node_id.hex()[:12]}")))
         self.pg_manager.on_node_removed(row)
         raylet.drain_for_removal(self.head())
+
+    def start_autoscaler(self, node_types, **kwargs) -> "StandardAutoscaler":
+        """Attach + start the autoscaler runtime loop (reference:
+        the monitor process running StandardAutoscaler.update)."""
+        from .autoscaler.autoscaler import StandardAutoscaler
+        self.autoscaler = StandardAutoscaler(self, node_types, **kwargs)
+        self.autoscaler.start()
+        return self.autoscaler
 
     def head(self) -> Raylet:
         return self.raylets[self._head_row]
@@ -204,6 +246,11 @@ class Cluster:
 
     # -- teardown -----------------------------------------------------------
     def stop(self) -> None:
+        self.health.shutdown()
+        if self.autoscaler is not None:
+            self.autoscaler.shutdown()
+        uninstall_counter(self.ref_counter)
+        self.ref_counter.shutdown()
         self.pg_manager.shutdown()
         self.pull_manager.shutdown()
         with self._lock:

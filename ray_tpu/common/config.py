@@ -118,6 +118,19 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "lineage_pinning_memory_mb": (
         int, 256,
         "Budget for pinned task specs kept for lineage reconstruction."),
+    # -- autoscaler ---------------------------------------------------------
+    "autoscaler_update_interval_ms": (
+        int, 1000,
+        "Autoscaler demand-collection period (reference: "
+        "AUTOSCALER_UPDATE_INTERVAL_S); infeasible arrivals also wake it."),
+    "autoscaler_idle_timeout_s": (
+        float, 60.0,
+        "Idle seconds before a worker node is terminated (reference: "
+        "idle_timeout_minutes)."),
+    "autoscaler_device_batch_min": (
+        int, 4096,
+        "Minimum total pending-demand count routed to the device binpack "
+        "kernel; smaller rounds use the bit-identical CPU oracle."),
     # -- device -------------------------------------------------------------
     # (score scale and max node count are compile-time contract constants in
     # scheduling/contract.py — SCALE, MAX_NODES — not runtime knobs: the key

@@ -1,0 +1,105 @@
+"""Automatic failure detection: the GCS health-check manager.
+
+Reference parity: ``GcsHealthCheckManager`` (``src/ray/gcs/gcs_server/
+gcs_health_check_manager.cc``) pings every registered raylet on
+``health_check_period_ms``; after ``health_check_failure_threshold``
+consecutive missed checks the node is declared dead and drained
+(SURVEY.md §5.3; mount empty).
+
+In-process adaptation: a node is declared DEAD on structural failure —
+its scheduling thread died or its worker pool is wiped out (all
+processes dead and respawn broken) — for ``threshold`` consecutive
+probes, then drained via ``cluster.remove_node``.  Event-loop
+responsiveness (pong answered since our previous ping) is tracked and
+surfaced as ``suspect`` in stats but is deliberately NOT fatal: a loop
+blocked 40 s in a first jit compile is indistinguishable in-process from
+a wedged one, and upstream only gets hang-detection for free because a
+hung raylet process also stops answering its RPC thread.  The head node
+is monitored but never removed (its death is fatal upstream too — the
+GCS lives there).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.config import get_config
+
+
+class HealthCheckManager:
+    def __init__(self, cluster):
+        cfg = get_config()
+        self._cluster = cluster
+        self._period = cfg.health_check_period_ms / 1000.0
+        self._threshold = cfg.health_check_failure_threshold
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        # NodeID -> {"misses": int, "pinged_at": float | None,
+        #            "suspect": bool}
+        self._state: dict = {}
+        self.num_detected = 0
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="health-check")
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=self._period)
+            if self._stop:
+                return
+            try:
+                self.check_once()
+            except Exception:   # noqa: BLE001 — monitor must survive
+                import traceback
+                traceback.print_exc()
+
+    def check_once(self) -> list:
+        """One probe round.  Returns nodes declared dead this round
+        (tests call this directly for determinism)."""
+        cluster = self._cluster
+        declared = []
+        for row, raylet in list(cluster.raylets.items()):
+            nid = raylet.node_id
+            st = self._state.setdefault(
+                nid, {"misses": 0, "pinged_at": None, "suspect": False})
+            vitals = raylet.health_vitals()
+            st["suspect"] = (st["pinged_at"] is not None and
+                            vitals["last_pong"] < st["pinged_at"])
+            if vitals["thread_alive"] and vitals["workers_alive"]:
+                st["misses"] = 0
+            else:
+                st["misses"] += 1
+                if st["misses"] >= self._threshold:
+                    if row == cluster._head_row:
+                        # head death is fatal upstream; keep flagging only
+                        continue
+                    self.num_detected += 1
+                    declared.append(nid)
+                    self._state.pop(nid, None)
+                    try:
+                        cluster.remove_node(nid)
+                    except ValueError:
+                        pass        # raced with a manual/autoscaler removal
+                    continue
+            st["pinged_at"] = time.monotonic()
+            raylet.ping()
+        # forget departed nodes
+        live = {r.node_id for r in cluster.raylets.values()}
+        for nid in [n for n in self._state if n not in live]:
+            del self._state[nid]
+        return declared
+
+    def stats(self) -> dict:
+        return {"num_detected": self.num_detected,
+                "num_monitored": len(self._state),
+                "num_suspect": sum(s["suspect"]
+                                   for s in self._state.values())}

@@ -49,6 +49,16 @@ class ObjectDirectory:
             for oid in object_ids:
                 self._locs.pop(oid, None)
 
+    def sole_copies_on(self, row: int) -> list[ObjectID]:
+        """Objects whose ONLY copy lives on ``row`` (a node holding any is
+        not safe to terminate; the autoscaler migrates them first)."""
+        out = []
+        with self._lock:
+            for oid, rows in self._locs.items():
+                if rows == {row}:
+                    out.append(oid)
+        return out
+
     def on_node_removed(self, row: int) -> list[ObjectID]:
         """Node death: its copies vanish.  Returns objects whose LAST copy
         was on the dead node — they are lost (upstream: reconstructed via

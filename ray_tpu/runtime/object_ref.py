@@ -1,20 +1,54 @@
 """ObjectRef — the future type returned by task submission and put.
 
 Reference parity: ``ray.ObjectRef`` wraps the 28-byte ObjectID plus owner
-metadata (``python/ray/includes/object_ref.pxi`` — SURVEY.md §1 layer 9;
-mount empty).  Resolution goes through ``ray_tpu.get``.
+metadata, and its construction/destruction drive the owner's
+``ReferenceCounter`` (``python/ray/includes/object_ref.pxi`` — SURVEY.md
+§1 layers 7/9; mount empty).  Resolution goes through ``ray_tpu.get``.
+
+The counter hook is process-global and installed only in the owner
+(driver) process — worker processes deserialize ObjectRefs freely with no
+counting (their borrows are covered by the retained TaskSpec's strong
+references on the driver side).  Each instance latches the counter it
+registered with so an uninstall (cluster teardown) never produces an
+unbalanced decref.
 """
 
 from __future__ import annotations
 
 from ..common.ids import ObjectID
 
+_counter = None     # the owner-process ReferenceCounter, or None
+
+
+def install_counter(counter) -> None:
+    """Make new ObjectRefs in this process count against ``counter``."""
+    global _counter
+    _counter = counter
+
+
+def uninstall_counter(counter) -> None:
+    global _counter
+    if _counter is counter:
+        _counter = None
+
 
 class ObjectRef:
-    __slots__ = ("_id",)
+    __slots__ = ("_id", "_ct")
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
+        ct = _counter
+        self._ct = ct
+        if ct is not None:
+            ct.incref(object_id)
+
+    def __del__(self):
+        ct = self._ct
+        if ct is not None:
+            try:
+                ct.decref(self._id)
+            except Exception:
+                pass        # interpreter teardown: counter may be gone
 
     @property
     def id(self) -> ObjectID:

@@ -276,6 +276,14 @@ class MemoryStore:
             except OSError:
                 pass
 
+    def routes_to_plasma(self, nbytes: int) -> bool:
+        """Will a payload of this size seal into the arena (directory-
+        tracked)?  Callers use this to pre-register locations BEFORE the
+        seal: sealing wakes dependent-task placement, so the directory
+        must already know where the bytes live or the locality probe
+        races an empty entry."""
+        return self.arena is not None and nbytes > self._threshold
+
     def plasma_info(self, object_id: ObjectID) -> tuple[str | None, int]:
         """(kind, size): kind is "shm" | "spill" (plasma-routed, has
         directory locations), "inband" (ships with specs), or None

@@ -101,12 +101,30 @@ class PlacementGroupManager:
         ready_oid = ready_oid_for(pg_id)
         rec = PlacementGroupRecord(pg_id, [dict(b) for b in bundles],
                                    strategy, name, ready_oid=ready_oid)
+        # the ready marker outlives any transient pg.ready() ObjectRef —
+        # pin it against refcount reclamation until the group is removed
+        self._cluster.ref_counter.pin(ready_oid)
         with self._lock:
             self._groups[pg_id] = rec
             if not self._try_place(rec):
                 self._pending.append(pg_id)
                 self._ensure_ticker()
+                # a group that cannot place is autoscaler demand
+                asc = getattr(self._cluster, "autoscaler", None)
+                if asc is not None:
+                    asc.kick()
         return ready_oid
+
+    def pending_bundle_demand(self) -> list[ResourceRequest]:
+        """Bundles of still-PENDING groups (autoscaler demand — reference:
+        pending placement groups feed get_nodes_to_launch)."""
+        with self._lock:
+            out = []
+            for pg_id in self._pending:
+                rec = self._groups.get(pg_id)
+                if rec is not None and rec.state == "PENDING":
+                    out.extend(ResourceRequest(b) for b in rec.bundles)
+            return out
 
     def _try_place(self, rec: PlacementGroupRecord) -> bool:
         """Place + 2-phase reserve. Caller holds the lock."""
@@ -235,6 +253,7 @@ class PlacementGroupManager:
                 self._crm.add_back(row, req)
             rec.state = "REMOVED"
             self._store.delete([rec.ready_oid])
+            self._cluster.ref_counter.unpin(rec.ready_oid)
         self._wake_raylets()
 
     # -- strategy resolution (shared by raylet + actor manager) -------------

@@ -22,6 +22,7 @@ resource release — the same wake set as the reference's asio event loop.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 import numpy as np
@@ -72,8 +73,20 @@ class Raylet:
         self._thread.start()
 
     # -- submission ---------------------------------------------------------
-    def submit(self, spec) -> list[ObjectRef]:
-        rec = self.task_manager.register(spec)
+    def submit(self, spec) -> None:
+        """Register + enter scheduling.  Deliberately returns NO
+        ObjectRefs: result refs are the caller's to create BEFORE
+        submitting (owner-side refcounting — a transient ref made here
+        and dropped would dip the count to zero and could reclaim the
+        result under a caller that has not built its refs yet)."""
+        self.submit_existing(self.task_manager.register(spec))
+
+    def submit_existing(self, rec) -> None:
+        """(Re-)enter an already-registered task into scheduling — the
+        lineage-reconstruction resubmit path shares this with first
+        submission (reference: reconstruction re-drives the normal task
+        path with attempt_number bumped)."""
+        spec = rec.spec
         deps = [a.id for a in spec.args if isinstance(a, ObjectRef)]
         missing = [d for d in deps if not self.store.contains(d)]
         if missing:
@@ -84,11 +97,54 @@ class Raylet:
                                     self._dep_ready(t))
         else:
             self._enqueue(spec.task_id)
-        return [ObjectRef(oid) for oid in rec.return_ids]
 
     def enqueue_forwarded(self, task_id: TaskID) -> None:
         """Arrival needing (re-)placement (deps already resolved)."""
         self._enqueue(task_id)
+
+    # -- autoscaler hooks ----------------------------------------------------
+    def pending_demand(self) -> list:
+        """Resource requests of tasks awaiting placement here (infeasible
+        parks) AND placed-but-undispatched backlog (resource-starved local
+        queue) — the raylet's share of autoscaler demand (reference:
+        LoadMetrics resource_load_by_shape includes both).  Local backlog
+        is safe to report: the packing pass fits demand onto existing free
+        capacity first, so only genuinely-starved tasks launch nodes."""
+        with self._cv:
+            ids = list(self._queue) + list(self._local_queue)
+        out = []
+        for tid in ids:
+            rec = self.task_manager.get(tid)
+            if rec is not None and not rec.done:
+                out.append(rec.spec.resources)
+        return out
+
+    def is_idle(self) -> bool:
+        """No queued, waiting, placed, or running work on this node."""
+        with self._cv:
+            return not (self._queue or self._local_queue or self._running
+                        or self._waiting or self._pull_pending)
+
+    # -- health (GCS health-check manager probes this) -----------------------
+    def ping(self) -> None:
+        """Health ping: wake the event loop so it re-stamps its pong
+        (reference: the raylet answering the GCS health-check RPC proves
+        its main loop turns)."""
+        self._notify_dirty()
+
+    @property
+    def last_pong(self) -> float:
+        return getattr(self, "_last_pong", 0.0)
+
+    def health_vitals(self) -> dict:
+        """Structural liveness (the manager decides staleness by comparing
+        ``last_pong`` against its own previous ping time)."""
+        return {
+            "thread_alive": self._thread.is_alive(),
+            "workers_alive": (self.pool.num_alive() > 0
+                              or self.pool.expected() == 0),
+            "last_pong": self.last_pong,
+        }
 
     def enqueue_local(self, task_id: TaskID) -> None:
         """Placement decided: this node owns the task until dispatch.
@@ -198,8 +254,14 @@ class Raylet:
         instead of busy-spinning."""
         while True:
             with self._cv:
-                while not self._stopped and not (
-                        self._dirty and (self._queue or self._local_queue)):
+                while True:
+                    # liveness pong: every wake (including health pings via
+                    # _notify_dirty) re-stamps — a wedged batch or a dead
+                    # thread stops the stamps and the health manager sees it
+                    self._last_pong = time.monotonic()
+                    if self._stopped or (self._dirty and
+                                         (self._queue or self._local_queue)):
+                        break
                     self._cv.wait()
                 if self._stopped:
                     return
@@ -213,6 +275,10 @@ class Raylet:
                         with self._cv:
                             # infeasible-now tasks park at the front, in order
                             self._queue.extendleft(reversed(leftover))
+                        # infeasible backlog is autoscaler demand: wake it
+                        asc = getattr(self.cluster, "autoscaler", None)
+                        if asc is not None:
+                            asc.kick()
                 self._drain_local()
             except Exception:   # noqa: BLE001 — one bad batch must not
                 # kill the node's scheduling thread (every later task
@@ -452,6 +518,11 @@ class Raylet:
             # fires the idle wake-up, so a speculative pop-then-release
             # would spin the loop)
             if not self.crm.subtract(self.row, spec.resources):
+                if not failed_classes:
+                    # resource-starved local backlog is autoscaler demand
+                    asc = getattr(self.cluster, "autoscaler", None)
+                    if asc is not None:
+                        asc.kick()
                 failed_classes.add(spec.resources.key())
                 misses += 1
                 scanned += 1
@@ -479,9 +550,16 @@ class Raylet:
         args = []
         pinned: list = []       # shm args stay pinned until task completion
         dep_error = None
+        vanished = None
         for a in spec.args:
             if isinstance(a, ObjectRef):
-                desc = self.store.descriptor_of(a.id)
+                try:
+                    desc = self.store.descriptor_of(a.id)
+                except KeyError:
+                    # arg vanished between placement and dispatch (lineage
+                    # recovery re-seal in flight): park until it reappears
+                    vanished = a.id
+                    break
                 if desc[0] == "s":
                     pinned.append((a.id, desc[1]))
                 if desc[0] == "v" and isinstance(desc[1], RayTaskError):
@@ -490,6 +568,15 @@ class Raylet:
                 args.append(ArgRef(desc))
             else:
                 args.append(a)
+        if vanished is not None:
+            self.store.unpin(pinned)
+            self.crm.add_back(self.row, spec.resources)
+            self.pool.release(worker)
+            with self._cv:
+                self._waiting[spec.task_id] = 1
+            self.store.on_ready(vanished, lambda _oid, t=spec.task_id:
+                                self._dep_ready(t))
+            return False
         if dep_error is not None:
             # propagate the dependency's error to this task's outputs
             # without executing (reference: failed deps fail the task)
@@ -512,6 +599,9 @@ class Raylet:
                 return False
             worker.fn_cache.add(fn_id)
         payload = serialize((tuple(args), spec.kwargs, spec.num_returns))
+        # lineage budget cost, measured here where the args are already
+        # serialized (complete() must not re-pickle under the manager lock)
+        rec.lineage_bytes = len(payload) + 256
         worker.leased_task = spec.task_id.binary()
         with self._cv:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
@@ -539,13 +629,15 @@ class Raylet:
         self.task_manager.complete(rec.spec.task_id)
         err = RayTaskError(rec.spec.function_descriptor, message)
         for oid in rec.return_ids:
-            self.store.put(oid, err)
+            if oid not in rec.dead_returns:
+                self.store.put(oid, err)
 
     def _finish_with_error(self, rec, error: RayTaskError,
                            worker: WorkerHandle | None) -> None:
         self.task_manager.complete(rec.spec.task_id)
         for oid in rec.return_ids:
-            self.store.put(oid, error)
+            if oid not in rec.dead_returns:
+                self.store.put(oid, error)
         self.crm.add_back(self.row, rec.spec.resources)
         if worker is not None:
             self.pool.release(worker)
@@ -594,15 +686,33 @@ class Raylet:
             if rec is not None:
                 if kind == "result":
                     for oid, data in zip(rec.return_ids, msg[2]):
+                        if oid in rec.dead_returns:
+                            continue    # reclaimed while out of scope: a
+                            # re-seal would live forever (no refs remain
+                            # to ever decref it)
+                        # plasma-routed results are born on this node;
+                        # the location is registered BEFORE the seal (the
+                        # seal wakes dependent placement, which reads the
+                        # directory for locality)
+                        plasma = self.store.routes_to_plasma(len(data))
+                        if plasma:
+                            self.cluster.directory.add_location(oid,
+                                                                self.row)
                         # size-routed: large payloads seal into the shared
                         # arena (zero-copy reads), small ones in-band
                         self.store.put_serialized(oid, data)
-                        # plasma-routed results are born on this node
-                        self.cluster.register_location(oid, self.row)
+                        if plasma and self.store.plasma_info(oid)[0] \
+                                not in ("shm", "spill"):
+                            # store-full in-band fallback: undo the
+                            # speculative directory entry
+                            self.cluster.directory.drop([oid])
+                        elif not plasma:
+                            self.cluster.register_location(oid, self.row)
                 else:
                     err = deserialize(msg[2])
                     for oid in rec.return_ids:
-                        self.store.put(oid, err)
+                        if oid not in rec.dead_returns:
+                            self.store.put(oid, err)
                 self.crm.add_back(self.row, rec.spec.resources)
             self.pool.release(worker)
             self._notify_dirty()
@@ -675,7 +785,13 @@ class Raylet:
             fn_id, fn_bytes = msg[2], msg[3]
             if fn_bytes is not None and fn_id not in self._fn_registry:
                 self._fn_registry[fn_id] = fn_bytes
-            self.submit(spec)
+            # no driver-side ObjectRefs for the results: the only live
+            # refs are in the submitting WORKER process, which is outside
+            # the owner counter — counted transients here would reclaim
+            # results the worker still needs.  Worker-held objects are
+            # simply never auto-reclaimed (conservative leak, reference
+            # borrower protocol's in-process simplification).
+            self.submit_existing(self.task_manager.register(spec))
         elif kind == "pg_create":
             from ..common.ids import PlacementGroupID
             from ..scheduling.bundles import PlacementStrategy

@@ -36,6 +36,7 @@ class ReferenceCounter:
         self._reclaim = None                # callback(oid): free the object
         self._contains = None               # callback(oid) -> bool (sealed?)
         self._on_ready = None               # store.on_ready registration
+        self._expects_seal = None           # callback(oid) -> bool
         self._stop = False
         self._thread: threading.Thread | None = None
 
@@ -56,13 +57,18 @@ class ReferenceCounter:
         self._wake.set()
 
     # -- lifecycle -----------------------------------------------------------
-    def attach(self, reclaim, contains, on_ready) -> None:
+    def attach(self, reclaim, contains, on_ready,
+               expects_seal=None) -> None:
         """Start the reclaimer: ``reclaim(oid)`` frees a dead object,
         ``contains(oid)`` tests sealed-ness, ``on_ready(oid, cb)`` defers
-        reclamation of not-yet-sealed objects."""
+        reclamation of not-yet-sealed objects, ``expects_seal(oid)`` says
+        whether an absent object will ever seal (a pending task return
+        will; a deleted put/ready-marker never will — registering a seal
+        listener for those would leak a closure per object forever)."""
         self._reclaim = reclaim
         self._contains = contains
         self._on_ready = on_ready
+        self._expects_seal = expects_seal
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="ref-counter")
         self._thread.start()
@@ -95,6 +101,9 @@ class ReferenceCounter:
                 if self._counts.get(oid, 0) <= 0:
                     dead.append(oid)
                 continue
+            if delta == 3:      # recheck-after-seal (deferred reclaim)
+                self._reclaim_if_still_dead(oid)
+                continue
             c = self._counts.get(oid, 0) + delta
             if c > 0:
                 self._counts[oid] = c
@@ -106,14 +115,25 @@ class ReferenceCounter:
             if oid in self._pinned or self._counts.get(oid, 0) > 0:
                 continue
             if self._contains is not None and not self._contains(oid):
+                if self._expects_seal is not None and \
+                        not self._expects_seal(oid):
+                    continue    # absent and never sealing: nothing to free
                 # unsealed (pending task output): reclaim when it seals,
                 # unless a new reference appears first
                 self._zero.add(oid)
                 if self._on_ready is not None:
-                    self._on_ready(oid, self._reclaim_if_still_dead)
+                    self._on_ready(oid, self._recheck_on_seal)
                 continue
             if self._reclaim is not None:
                 self._reclaim(oid)
+
+    def _recheck_on_seal(self, oid: ObjectID) -> None:
+        """Seal callback for a deferred reclaim: routed through the event
+        queue (not decided inline) so any incref already queued when the
+        object seals folds FIRST — deciding here could reclaim an object
+        whose new reference is still in flight."""
+        self._events.append((3, oid))
+        self._wake.set()
 
     def _reclaim_if_still_dead(self, oid: ObjectID) -> None:
         if oid in self._zero and oid not in self._pinned \

@@ -1,16 +1,21 @@
-"""Owner-side task bookkeeping: lifetimes, retries, completion.
+"""Owner-side task bookkeeping: lifetimes, retries, completion, lineage.
 
 Reference parity: the core worker's ``TaskManager`` (retry budget and
-completion accounting for submitted tasks) — ``src/ray/core_worker/
-task_manager.cc``, SURVEY.md §1 layer 7; mount empty.  Lineage pinning for
-reconstruction builds on the ``specs`` this manager retains.
+completion accounting for submitted tasks) plus its lineage pinning —
+completed specs are retained for object reconstruction until the
+``lineage_pinning_memory_mb`` budget evicts them oldest-first, and a
+record is released early once every return object has gone out of scope
+(``src/ray/core_worker/task_manager.cc``, SURVEY.md §1 layer 7, §5.3/§5.4;
+mount empty).
 """
 
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from collections import OrderedDict
+from dataclasses import dataclass, field
 
+from ..common.config import get_config
 from ..common.ids import ObjectID, TaskID
 from ..common.task_spec import TaskSpec
 
@@ -21,12 +26,21 @@ class TaskRecord:
     retries_left: int
     return_ids: list[ObjectID]
     done: bool = False
+    recovering: bool = False        # a reconstruction resubmit is in flight
+    lineage_bytes: int = 0          # retained-spec cost while done
+    dead_returns: set = field(default_factory=set)
 
 
 class TaskManager:
     def __init__(self):
         self._lock = threading.Lock()
         self._records: dict[TaskID, TaskRecord] = {}
+        # completed records in retention order (lineage eviction is FIFO:
+        # oldest finished task loses reconstructability first)
+        self._done: "OrderedDict[TaskID, TaskRecord]" = OrderedDict()
+        self._lineage_bytes = 0
+        self._budget = get_config().lineage_pinning_memory_mb * (1 << 20)
+        self.lineage_evictions = 0
 
     def register(self, spec: TaskSpec) -> TaskRecord:
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
@@ -41,11 +55,76 @@ class TaskManager:
             return self._records.get(task_id)
 
     def complete(self, task_id: TaskID) -> TaskRecord | None:
+        """Mark done and move the record into the lineage retention window
+        (sized by ``lineage_pinning_memory_mb``); evicted records lose
+        reconstructability, and their specs' strong references to argument
+        ObjectRefs drop (the refcount cascade)."""
         with self._lock:
             rec = self._records.get(task_id)
-            if rec is not None:
-                rec.done = True
+            if rec is None:
+                return None
+            if rec.done:                # double-completion (cancel races a
+                return rec              # late result): already accounted
+            rec.done = True
+            rec.recovering = False
+            if not rec.lineage_bytes:
+                # never dispatched (failed pre-dispatch): flat floor — the
+                # dispatch path stamps the real serialized size
+                rec.lineage_bytes = 256
+            if rec.dead_returns.issuperset(rec.return_ids):
+                # nothing downstream can ever need this lineage
+                del self._records[task_id]
+                return rec
+            self._done[task_id] = rec
+            self._lineage_bytes += rec.lineage_bytes
+            self._evict_over_budget_locked()
             return rec
+
+    def _evict_over_budget_locked(self) -> None:
+        while self._lineage_bytes > self._budget and self._done:
+            tid, rec = self._done.popitem(last=False)
+            self._lineage_bytes -= rec.lineage_bytes
+            self._records.pop(tid, None)
+            self.lineage_evictions += 1
+
+    def on_return_reclaimed(self, object_id: ObjectID) -> None:
+        """A return object went out of scope cluster-wide: once ALL of a
+        finished task's returns are dead its lineage is released (nothing
+        can ask for reconstruction — reference: lineage release on
+        out-of-scope, SURVEY §5.3)."""
+        tid = object_id.task_id()
+        with self._lock:
+            rec = self._records.get(tid)
+            if rec is None:
+                return
+            rec.dead_returns.add(object_id)
+            if rec.done and rec.dead_returns.issuperset(rec.return_ids):
+                del self._records[tid]
+                if self._done.pop(tid, None) is not None:
+                    self._lineage_bytes -= rec.lineage_bytes
+
+    def mark_reconstructing(self, task_id: TaskID) -> bool:
+        """Claim a record for a reconstruction resubmit.  Consumes one
+        retry; False when already in flight (dedupe), unknown, evicted, or
+        out of retries."""
+        with self._lock:
+            rec = self._records.get(task_id)
+            if rec is None:
+                return False
+            if rec.recovering or not rec.done:
+                return True     # a resubmit (or first run) is in flight
+            if rec.retries_left <= 0:
+                return False
+            rec.retries_left -= 1
+            rec.spec.attempt_number += 1
+            rec.done = False
+            rec.recovering = True
+            if self._done.pop(task_id, None) is not None:
+                self._lineage_bytes -= rec.lineage_bytes
+            # dead_returns is kept: already-reclaimed returns must NOT be
+            # re-sealed by the reconstruction (a re-sealed dead return has
+            # no refs and no pending decref — it would never be reclaimed)
+            return True
 
     def should_retry(self, task_id: TaskID) -> bool:
         """Consume one retry if any remain (worker-crash path)."""
@@ -61,11 +140,9 @@ class TaskManager:
         with self._lock:
             return sum(not r.done for r in self._records.values())
 
-    def pop_finished(self, keep_lineage: bool = True) -> None:
-        """Drop completed records (lineage pinning keeps them by default
-        until the reconstruction budget evicts — SURVEY §5.3/§5.4)."""
-        if keep_lineage:
-            return
+    def stats(self) -> dict:
         with self._lock:
-            for tid in [t for t, r in self._records.items() if r.done]:
-                del self._records[tid]
+            return {"num_records": len(self._records),
+                    "num_done_retained": len(self._done),
+                    "lineage_bytes": self._lineage_bytes,
+                    "lineage_evictions": self.lineage_evictions}

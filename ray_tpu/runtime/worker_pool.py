@@ -183,6 +183,11 @@ class WorkerPool:
         with self._lock:
             return sum(not h.dead for h in self._workers)
 
+    def expected(self) -> int:
+        """Configured steady-state pool size (health checks compare
+        num_alive against this)."""
+        return self._num
+
     def grow_for_blocked(self, max_factor: int = 4) -> bool:
         """Spawn one extra worker when the pool is starved by workers
         parked in a blocking get (reference: workers blocked in ray.get

@@ -1,0 +1,258 @@
+"""Reference counting, lineage retention, and object reconstruction.
+
+Scenario sources: upstream ``reference_count_test.cc`` /
+``object_recovery_manager_test.cc`` behavioral contract — out-of-scope
+deletion, lineage release when all returns die, reconstruction of lost
+objects from retained specs, put objects unrecoverable (SURVEY.md §1
+layer 7, §5.3; scenarios re-derived, not copied)."""
+
+import gc
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.api import _get_runtime
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.common.config import Config
+from ray_tpu.runtime.object_store import ObjectLostError
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def _flush(cluster, rounds=3):
+    """Deterministic fold of pending ref events (plus GC)."""
+    for _ in range(rounds):
+        gc.collect()
+        cluster.ref_counter.flush()
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+@pytest.fixture
+def driver():
+    ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=2)
+    rt = _get_runtime()
+    yield rt
+    ray_tpu.shutdown()
+
+
+class TestRefCounting:
+    def test_put_out_of_scope_reclaims(self, driver):
+        c = driver.cluster
+        before = c.store.size()
+        ref = ray_tpu.put({"k": list(range(100))})
+        oid = ref.id
+        assert c.store.contains(oid)
+        assert c.ref_counter.count_of(oid) >= 0   # events may be queued
+        del ref
+        _flush(c)
+        assert not c.store.contains(oid)
+        assert c.store.size() <= before
+
+    def test_live_ref_is_not_reclaimed(self, driver):
+        c = driver.cluster
+        ref = ray_tpu.put("keep me")
+        _flush(c)
+        assert c.store.contains(ref.id)
+        assert ray_tpu.get(ref) == "keep me"
+
+    def test_task_return_out_of_scope_after_seal(self, driver):
+        c = driver.cluster
+
+        @ray_tpu.remote
+        def f():
+            return 41
+
+        ref = f.remote()
+        assert ray_tpu.get(ref, timeout=30) == 41
+        oid = ref.id
+        del ref
+        _flush(c)
+        assert not c.store.contains(oid)
+
+    def test_return_dropped_before_seal_reclaims_on_seal(self, driver):
+        c = driver.cluster
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.3)
+            return "x"
+
+        ref = slow.remote()
+        oid = ref.id
+        del ref
+        _flush(c)                     # folds the decref; object unsealed
+        assert _wait_until(lambda: c.store.contains(oid) or True)
+        # once the task seals, the deferred reclaim fires
+        assert _wait_until(
+            lambda: (_flush(c) or not c.store.contains(oid)), timeout=15)
+
+    def test_sustained_workload_steady_store(self, driver):
+        c = driver.cluster
+
+        @ray_tpu.remote
+        def step(i):
+            return i * 3
+
+        for i in range(40):
+            assert ray_tpu.get(step.remote(i), timeout=30) == i * 3
+        _flush(c)
+        # every return went out of scope: the store does not accumulate
+        assert c.store.size() <= 4
+        # lineage released too (all returns dead)
+        assert c.task_manager.stats()["num_records"] <= 4
+
+    def test_shm_object_reclaims_arena_bytes(self, driver):
+        c = driver.cluster
+        payload = os.urandom(512 * 1024)          # > direct-call threshold
+        ref = ray_tpu.put(payload)
+        _flush(c)
+        assert c.store.plasma_info(ref.id)[0] == "shm"
+        used_with = c.arena.bytes_in_use()
+        oid = ref.id
+        del ref
+        _flush(c)
+        assert not c.store.contains(oid)
+        assert c.arena.bytes_in_use() < used_with
+        assert not c.directory.is_tracked(oid)
+
+    def test_pg_ready_marker_survives_transient_refs(self, driver):
+        pg = placement_group([{"CPU": 1}])
+        ray_tpu.get(pg.ready(), timeout=30)       # transient ready refs
+        _flush(driver.cluster)
+        ray_tpu.get(pg.ready(), timeout=30)       # marker must still exist
+        remove_placement_group(pg)
+
+
+class TestLineage:
+    def test_lineage_budget_evicts_oldest(self):
+        Config.reset({"lineage_pinning_memory_mb": 1})
+        ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=2)
+        try:
+            c = _get_runtime().cluster
+
+            # lineage cost is the retained SPEC size: pad the args
+            @ray_tpu.remote
+            def padded(data, i):
+                return i
+
+            keep = []
+            for i in range(12):
+                keep.append(padded.remote(bytes(200_000), i))
+            assert ray_tpu.get(keep, timeout=60) == list(range(12))
+            stats = c.task_manager.stats()
+            # 12 × ~200KB specs ≫ 1MB budget: evictions must have fired
+            assert stats["lineage_evictions"] > 0
+            assert stats["lineage_bytes"] <= 1 << 20
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestReconstruction:
+    def _two_node_cluster(self):
+        c = Cluster()
+        c.add_node(resources={"CPU": 2, "memory": 2}, num_workers=2)
+        doomed = c.add_node(resources={"CPU": 2, "memory": 2},
+                            num_workers=2)
+        return c, doomed
+
+    def test_lost_object_reconstructs(self, tmp_path):
+        marker = tmp_path / "runs"
+        c, doomed = self._two_node_cluster()
+        ray_tpu.init(cluster=c)
+        try:
+            @ray_tpu.remote(max_retries=2)
+            def produce(path):
+                with open(path, "a") as f:
+                    f.write("x")
+                return os.urandom(300_000)        # shm-routed
+
+            ref = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=doomed, soft=True)).remote(str(marker))
+            # wait (presence only), NOT get: a driver get would pull a
+            # copy to the head at GET priority, and then removing the
+            # producer node would lose nothing
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+            assert ready == [ref]
+            assert marker.read_text() == "x"
+            row = c.crm.row_of(doomed)
+            assert c.directory.locations(ref.id) == (row,)
+            c.remove_node(doomed)
+            # the only copy lived on the dead node: lineage re-executes
+            again = ray_tpu.get(ref, timeout=60)
+            assert len(again) == 300_000
+            assert _wait_until(lambda: marker.read_text() == "xx")
+            assert c.recovery.num_reconstructions == 1
+        finally:
+            ray_tpu.shutdown()
+            c.stop()
+
+    def test_lost_put_object_poisons(self, tmp_path):
+        c, doomed = self._two_node_cluster()
+        ray_tpu.init(cluster=c)
+        try:
+            # a put born on the doomed node: fabricate by registering its
+            # location there (driver puts are born on the head in the API;
+            # the directory is the source of truth for loss)
+            ref = ray_tpu.put(os.urandom(300_000))
+            row = c.crm.row_of(doomed)
+            head_row = c.head().row
+            c.directory.drop([ref.id])
+            c.directory.add_location(ref.id, row)
+            c.remove_node(doomed)
+            with pytest.raises(ObjectLostError):
+                ray_tpu.get(ref, timeout=10)
+            assert c.recovery.num_unrecoverable >= 1
+            assert head_row != row
+        finally:
+            ray_tpu.shutdown()
+            c.stop()
+
+    def test_recursive_reconstruction(self, tmp_path):
+        marker = tmp_path / "runs"
+        c, doomed = self._two_node_cluster()
+        ray_tpu.init(cluster=c)
+        try:
+            aff = NodeAffinitySchedulingStrategy(node_id=doomed, soft=True)
+
+            @ray_tpu.remote(max_retries=2)
+            def stage_a(path):
+                with open(path, "a") as f:
+                    f.write("a")
+                return os.urandom(200_000)
+
+            @ray_tpu.remote(max_retries=2)
+            def stage_b(data, path):
+                with open(path, "a") as f:
+                    f.write("b")
+                return data + os.urandom(100_000)     # shm-routed output
+
+            a_ref = stage_a.options(scheduling_strategy=aff).remote(
+                str(marker))
+            b_ref = stage_b.options(scheduling_strategy=aff).remote(
+                a_ref, str(marker))
+            # wait, not get (a get would pull a head copy — see above)
+            ready, _ = ray_tpu.wait([b_ref], num_returns=1, timeout=30)
+            assert ready == [b_ref]
+            # both outputs' only copies live on the doomed node: removing
+            # it must recursively re-run a then b from lineage
+            c.remove_node(doomed)
+            assert len(ray_tpu.get(b_ref, timeout=60)) == 300_000
+            assert _wait_until(
+                lambda: marker.read_text().count("a") == 2 and
+                marker.read_text().count("b") == 2)
+            assert c.recovery.num_reconstructions >= 2
+        finally:
+            ray_tpu.shutdown()
+            c.stop()
