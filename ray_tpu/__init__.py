@@ -23,7 +23,8 @@ from .common import (Config, NodeResources, ResourceRequest, get_config)
 # `import ray_tpu` light for scheduler-only users (e.g. the bench harness).
 _API_NAMES = ("init", "shutdown", "is_initialized", "remote", "get", "put",
               "wait", "cancel", "kill", "get_actor",
-              "available_resources", "cluster_resources", "nodes")
+              "available_resources", "cluster_resources", "nodes",
+              "timeline")
 
 
 def __getattr__(name):

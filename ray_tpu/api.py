@@ -90,9 +90,13 @@ class DriverRuntime:
         from .common.ids import ObjectID
         oid = ObjectID.for_put(self.driver_task_id, idx)
         # size-routed like the reference: large serialized payloads seal
-        # into the shared arena; small values stay in-band
-        self.store.put_value(oid, value, serialize(value))
-        self.cluster.register_location(oid, self.raylet.row)
+        # into the shared arena (location pre-registered — see
+        # Cluster.seal_serialized); small values stay in-band
+        data = serialize(value)
+        if self.store.routes_to_plasma(len(data)):
+            self.cluster.seal_serialized(oid, data, self.raylet.row)
+        else:
+            self.store.put(oid, value)
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):
@@ -441,6 +445,17 @@ def cluster_resources() -> dict[str, float]:
                 name = rt.crm.resource_index.name(col)
                 out[name] = out.get(name, 0.0) + from_cu(cu)
     return out
+
+
+def timeline(filename: str | None = None):
+    """Task/cluster lifecycle events in Chrome trace format (reference:
+    ``ray.timeline``).  Returns the event list, or writes it to
+    ``filename`` and returns the path."""
+    rt = _get_runtime()
+    events = rt.cluster.events
+    if filename is not None:
+        return events.dump_timeline(filename)
+    return events.timeline()
 
 
 def nodes() -> list[dict]:

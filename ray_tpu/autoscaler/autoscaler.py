@@ -77,8 +77,11 @@ class StandardAutoscaler:
         self._wake.set()
 
     def shutdown(self) -> None:
+        """Stop AND join (an in-flight update must not race teardown)."""
         self._stop = True
         self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def _loop(self) -> None:
         while not self._stop:
@@ -174,6 +177,10 @@ class StandardAutoscaler:
                 self.num_launched += 1
                 launched[self._types[k].name] = \
                     launched.get(self._types[k].name, 0) + 1
+        if launched:
+            self._cluster.events.emit("autoscaler", "nodes_launched",
+                                      launches=launched,
+                                      unmet=self.last_unmet)
         return launched
 
     def _scale_down(self) -> list:
@@ -202,6 +209,10 @@ class StandardAutoscaler:
                 t0 = self._idle_since.setdefault(raylet.node_id, now)
                 if (now - t0 >= self._idle_timeout and
                         live_workers - len(terminated) > self._min_workers):
+                    cluster.events.emit(
+                        "autoscaler", "idle_node_terminated", node_row=row,
+                        node_id=raylet.node_id.hex(),
+                        idle_seconds=now - t0)
                     cluster.remove_node(raylet.node_id)
                     self._idle_since.pop(raylet.node_id, None)
                     self.num_terminated += 1

@@ -117,9 +117,16 @@ class Cluster:
                                 self.store.on_ready, self._expects_seal)
         install_counter(self.ref_counter)
         self.autoscaler = None          # attached by start_autoscaler
+        from .runtime.events import EventLog
+        self.events = EventLog(self.session_dir)
         from .runtime.health import HealthCheckManager
         self.health = HealthCheckManager(self)
         self.health.start()
+        port = get_config().metrics_export_port
+        self.metrics = None
+        if port:
+            from .runtime.metrics import MetricsExporter
+            self.metrics = MetricsExporter(self, port)
         self._head_row: int | None = None
 
     def _reclaim_object(self, oid) -> None:
@@ -154,6 +161,8 @@ class Cluster:
             if self._head_row is None:
                 self._head_row = row
         raylet.start()
+        self.events.emit("node", "node_added", node_row=row,
+                         node_id=node_id.hex(), resources=resources)
         if wait and num_workers:
             raylet.pool.wait_ready(num_workers, timeout=60.0)
         # wake every existing raylet: tasks parked as infeasible may now
@@ -191,6 +200,21 @@ class Cluster:
         if kind in ("shm", "spill"):
             self.directory.add_location(oid, row)
 
+    def seal_serialized(self, oid, data, row: int) -> None:
+        """Seal a serialized payload born on ``row`` with the directory
+        entry registered BEFORE the seal: sealing wakes dependent-task
+        placement and driver gets, which read the directory for locality
+        — registering after would race an empty entry."""
+        plasma = self.store.routes_to_plasma(len(data))
+        if plasma:
+            self.directory.add_location(oid, row)
+        self.store.put_serialized(oid, data)
+        if plasma and self.store.plasma_info(oid)[0] not in ("shm",
+                                                            "spill"):
+            self.directory.drop([oid])  # store-full in-band fallback
+        elif not plasma:
+            self.register_location(oid, row)
+
     def remove_node(self, node_id: NodeID) -> None:
         """Simulate node death: resources vanish, running tasks retried
         elsewhere (or failed), queued tasks re-routed, actors restarted or
@@ -202,6 +226,8 @@ class Cluster:
                 raise ValueError("cannot remove head node or unknown node")
             raylet = self.raylets.pop(row)
             self.crm.remove_node(node_id)
+        self.events.emit("node", "node_removed", node_row=row,
+                         node_id=node_id.hex())
         lost = self.directory.on_node_removed(row)
         self.pull_manager.on_objects_lost(lost)
         from .runtime.serialization import RayTaskError
@@ -258,6 +284,9 @@ class Cluster:
             self.raylets.clear()
         for r in raylets:
             r.stop()
+        if self.metrics is not None:
+            self.metrics.shutdown()
+        self.events.close()
         self.arena.close()
         import shutil
         shutil.rmtree(self.session_dir, ignore_errors=True)

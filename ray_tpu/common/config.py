@@ -55,10 +55,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "scheduler_top_k_absolute": (
         int, 1,
         "Floor for the top-k node count when top_k_fraction > 0."),
-    "scheduler_report_period_ms": (
-        int, 100,
-        "Resource-view sync period (reference: "
-        "raylet_report_resources_period_milliseconds)."),
+    # (the reference's raylet_report_resources_period_milliseconds has no
+    # counterpart here: the in-process CRM is one shared authoritative
+    # view, so there is no resource-report staleness to configure)
     "scheduler_device_backend": (
         bool, True,
         "Evaluate batched placement on the TPU kernel; False forces the CPU "

@@ -36,6 +36,7 @@ class SchedulingStrategyKind(enum.Enum):
     SPREAD = 1             # round-robin over feasible nodes
     NODE_AFFINITY = 2      # pin to node (soft or hard)
     PLACEMENT_GROUP = 3    # pin to a reserved bundle
+    NODE_LABEL = 4         # restrict to nodes matching a label selector
 
 
 @dataclass(frozen=True)
@@ -47,6 +48,8 @@ class SchedulingStrategy:
     # PLACEMENT_GROUP
     placement_group_id: PlacementGroupID | None = None
     bundle_index: int = -1
+    # NODE_LABEL: sorted ((key, value), ...) pairs (tuple: frozen+hashable)
+    label_selector: tuple = ()
 
     def key(self) -> tuple:
         return (self.kind.value,
@@ -54,7 +57,8 @@ class SchedulingStrategy:
                 self.soft,
                 self.placement_group_id.binary()
                 if self.placement_group_id else b"",
-                self.bundle_index)
+                self.bundle_index,
+                self.label_selector)
 
 
 DEFAULT_STRATEGY = SchedulingStrategy()

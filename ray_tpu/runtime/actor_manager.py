@@ -297,9 +297,12 @@ class ActorManager:
                 row = rec.row if rec is not None else -1
                 for i, data in enumerate(msg[2]):
                     oid = ObjectID.for_task_return(call.task_id, i + 1)
-                    self._store.put_serialized(oid, data)
                     if row >= 0:
-                        self._cluster.register_location(oid, row)
+                        # pre-registered location (directory before seal —
+                        # Cluster.seal_serialized rationale)
+                        self._cluster.seal_serialized(oid, data, row)
+                    else:
+                        self._store.put_serialized(oid, data)
             else:
                 err = deserialize(msg[2])
                 for i in range(call.num_returns):

@@ -48,8 +48,13 @@ class HealthCheckManager:
             self._thread.start()
 
     def shutdown(self) -> None:
+        """Stop AND join: an in-flight round emitting events or removing
+        nodes must not race cluster teardown (it could recreate the
+        just-deleted session dir through the event log's lazy open)."""
         self._stop = True
         self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def _loop(self) -> None:
         while not self._stop:
@@ -85,6 +90,9 @@ class HealthCheckManager:
                     self.num_detected += 1
                     declared.append(nid)
                     self._state.pop(nid, None)
+                    cluster.events.emit(
+                        "health", "node_declared_dead", node_row=row,
+                        node_id=nid.hex(), misses=st["misses"])
                     try:
                         cluster.remove_node(nid)
                     except ValueError:

@@ -1,0 +1,153 @@
+"""Prometheus metrics endpoint (observability floor).
+
+Reference parity: upstream exports core metrics (scheduler queue depths,
+object store usage, worker counts) via OpenCensus to a Prometheus
+scrape endpoint on ``metrics_export_port`` (``src/ray/stats/metric_defs.cc``,
+``python/ray/_private/metrics_agent.py`` — SURVEY.md §1 layer 12, §5.5;
+mount empty).
+
+Pull-model: gauges are computed at scrape time straight from the live
+runtime objects (CRM arrays, raylet queues, store/pull/lineage stats) —
+no sampling thread, no drift.  Text exposition format 0.0.4, the one
+Prometheus scrapes.  ``metrics_export_port`` 0 disables; passing port 0
+to ``MetricsExporter`` directly binds an ephemeral port (tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _fmt(name: str, value, help_text: str, labels: dict | None = None,
+         out: list | None = None) -> None:
+    out.append(f"# HELP ray_tpu_{name} {help_text}")
+    out.append(f"# TYPE ray_tpu_{name} gauge")
+    if labels:
+        lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        out.append(f"ray_tpu_{name}{{{lbl}}} {value}")
+    else:
+        out.append(f"ray_tpu_{name} {value}")
+
+
+def render_metrics(cluster) -> str:
+    """One scrape: the cluster's live state as Prometheus text."""
+    out: list[str] = []
+    raylets = list(cluster.raylets.items())
+
+    # scheduler: queue depths + placement latency
+    pending = placed = running = 0
+    durations: list[float] = []
+    workers_alive = workers_expected = 0
+    for _row, r in raylets:
+        qs = r.queue_stats()
+        pending += qs["pending"]
+        placed += qs["placed"]
+        running += qs["running"]
+        durations.extend(qs["round_durations"])
+        workers_alive += r.pool.num_alive()
+        workers_expected += r.pool.expected()
+    _fmt("scheduler_pending_tasks", pending,
+         "Tasks awaiting placement across raylets", out=out)
+    _fmt("scheduler_placed_tasks", placed,
+         "Tasks placed, awaiting dispatch", out=out)
+    _fmt("scheduler_running_tasks", running,
+         "Tasks currently executing", out=out)
+    if durations:
+        durations.sort()
+        p50 = durations[len(durations) // 2]
+        _fmt("scheduler_placement_round_p50_seconds", f"{p50:.6f}",
+             "Median scheduling-round duration", out=out)
+    _fmt("num_nodes", len(raylets), "Live nodes", out=out)
+    _fmt("num_workers_alive", workers_alive, "Live worker processes",
+         out=out)
+    _fmt("num_workers_expected", workers_expected,
+         "Configured worker pool size", out=out)
+
+    # object store
+    ss = cluster.store.stats()
+    _fmt("object_store_objects", ss["num_objects"], "Sealed objects",
+         out=out)
+    _fmt("object_store_arena_bytes_in_use", ss["arena_bytes_in_use"],
+         "Shared-memory arena bytes in use", out=out)
+    _fmt("object_store_arena_capacity_bytes", ss["arena_capacity"],
+         "Shared-memory arena capacity", out=out)
+    _fmt("object_store_spilled_bytes_total", ss["spilled_bytes"],
+         "Bytes spilled to disk (cumulative)", out=out)
+    _fmt("object_store_restored_bytes_total", ss["restored_bytes"],
+         "Bytes restored from spill (cumulative)", out=out)
+    _fmt("object_store_pinned_objects", ss["num_pinned"],
+         "Objects pinned by outstanding descriptors", out=out)
+
+    # object transfer
+    ps = cluster.pull_manager.stats()
+    _fmt("pull_manager_pulls_total", ps["num_pulls"],
+         "Completed pulls (cumulative)", out=out)
+    _fmt("pull_manager_bytes_pulled_total", ps["bytes_pulled"],
+         "Bytes transferred by pulls (cumulative)", out=out)
+    _fmt("pull_manager_inflight_bytes", ps["inflight_bytes"],
+         "Bytes in active transfers", out=out)
+
+    # ownership / lineage
+    ts = cluster.task_manager.stats()
+    _fmt("lineage_retained_specs", ts["num_done_retained"],
+         "Completed specs retained for reconstruction", out=out)
+    _fmt("lineage_bytes", ts["lineage_bytes"],
+         "Bytes of retained lineage", out=out)
+    rs = cluster.ref_counter.stats()
+    _fmt("refcounted_objects", rs["num_tracked"],
+         "Objects with live references", out=out)
+    _fmt("reconstructions_total", cluster.recovery.num_reconstructions,
+         "Objects reconstructed from lineage (cumulative)", out=out)
+
+    # health + autoscaler + events
+    _fmt("health_nodes_declared_dead_total", cluster.health.num_detected,
+         "Nodes declared dead by health checks (cumulative)", out=out)
+    if cluster.autoscaler is not None:
+        a = cluster.autoscaler.stats()
+        _fmt("autoscaler_nodes_launched_total", a["num_launched"],
+             "Nodes launched (cumulative)", out=out)
+        _fmt("autoscaler_nodes_terminated_total", a["num_terminated"],
+             "Idle nodes terminated (cumulative)", out=out)
+    ev = getattr(cluster, "events", None)
+    if ev is not None:
+        _fmt("events_emitted_total", ev.num_events,
+             "Structured events emitted (cumulative)", out=out)
+    return "\n".join(out) + "\n"
+
+
+class MetricsExporter:
+    """Scrape endpoint: ``GET /metrics`` on ``metrics_export_port``."""
+
+    def __init__(self, cluster, port: int):
+        self._cluster = cluster
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render_metrics(exporter._cluster).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"metrics-{self.port}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -104,16 +104,6 @@ class MemoryStore:
         for cb in listeners:
             cb(object_id)
 
-    def put_value(self, object_id: ObjectID, value, serialized=None
-                  ) -> None:
-        """Seal a value whose serialized form is already known: routes
-        large payloads to the arena without re-deserializing small ones."""
-        if serialized is not None and self.arena is not None and \
-                len(serialized) > self._threshold:
-            self.put_serialized(object_id, serialized)
-        else:
-            self.put(object_id, value)
-
     def put_serialized(self, object_id: ObjectID, data) -> None:
         """Seal a serialized payload, routing by size: large payloads go
         to the shared arena (zero-copy reads), small ones are held in-band

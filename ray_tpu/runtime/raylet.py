@@ -57,6 +57,10 @@ class Raylet:
         self._pull_pending: dict[TaskID, int] = {}  # task -> in-flight pulls
         # task_id_bin -> (TaskID, WorkerHandle, pinned shm-arg batch)
         self._running: dict[bytes, tuple[TaskID, WorkerHandle, list]] = {}
+        self._task_start: dict[bytes, float] = {}   # timeline spans
+        self._round_durations: deque = deque(maxlen=256)    # metrics p50
+        self._local_since: dict[TaskID, float] = {}  # lease-wait clocks
+        self._avoid_local: set[TaskID] = set()  # lease-spilled: skip here
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
@@ -125,6 +129,14 @@ class Raylet:
             return not (self._queue or self._local_queue or self._running
                         or self._waiting or self._pull_pending)
 
+    def queue_stats(self) -> dict:
+        """Live depths + recent scheduling-round durations (metrics)."""
+        with self._cv:
+            return {"pending": len(self._queue) + len(self._waiting),
+                    "placed": len(self._local_queue),
+                    "running": len(self._running),
+                    "round_durations": list(self._round_durations)}
+
     # -- health (GCS health-check manager probes this) -----------------------
     def ping(self) -> None:
         """Health ping: wake the event loop so it re-stamps its pong
@@ -172,6 +184,7 @@ class Raylet:
             if pulls:
                 self._pull_pending[task_id] = len(pulls)
             self._local_queue.append(task_id)
+            self._local_since[task_id] = time.monotonic()
             self._dirty = True
             self._cv.notify_all()
         if pulls:
@@ -268,6 +281,7 @@ class Raylet:
                 self._dirty = False
                 batch = list(self._queue)
                 self._queue.clear()
+            round_t0 = time.monotonic()
             try:
                 if batch:
                     leftover = self._place_batch(batch)
@@ -280,6 +294,9 @@ class Raylet:
                         if asc is not None:
                             asc.kick()
                 self._drain_local()
+                if batch:
+                    self._round_durations.append(
+                        time.monotonic() - round_t0)
             except Exception:   # noqa: BLE001 — one bad batch must not
                 # kill the node's scheduling thread (every later task
                 # would hang); the batch's tasks are lost to this round
@@ -301,11 +318,15 @@ class Raylet:
         # no locality signal; the locality probe (store+directory locks per
         # arg) runs only when the batch is otherwise device-eligible —
         # the host path computes it once per spec inside _options_for
-        if cfg.scheduler_device_backend and \
-                len(batch) >= cfg.scheduler_device_batch_min and \
-                all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
-                    for s in specs) and \
-                all(self._locality_row(s) is None for s in specs):
+        if (cfg.scheduler_device_backend
+                and cfg.scheduler_top_k_fraction == 0
+                and not self._avoid_local
+                and len(batch) >= cfg.scheduler_device_batch_min
+                and all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
+                        for s in specs)
+                and len({s.scheduling_class() for s in specs})
+                    <= cfg.tpu_group_capacity
+                and all(self._locality_row(s) is None for s in specs)):
             return self._schedule_rows_device(specs)
         # per-task CPU policy on a snapshot (sequential within the round),
         # partitioned by scheduling class in first-appearance order — the
@@ -420,6 +441,13 @@ class Raylet:
     def _options_for(self, spec, n_rows: int) -> SchedulingOptions:
         kind = spec.strategy.kind
         if kind is SchedulingStrategyKind.DEFAULT:
+            if spec.task_id in self._avoid_local:
+                # lease-timeout spillback: one placement that excludes
+                # this node (flag consumed; locality skipped — the local
+                # data node is exactly what starved the task)
+                self._avoid_local.discard(spec.task_id)
+                return SchedulingOptions(avoid_local_node=True,
+                                         local_node_row=self.row)
             row = self._locality_row(spec)
             if row is not None:
                 # soft affinity: land on the max-local-bytes node when it
@@ -436,6 +464,12 @@ class Raylet:
                 scheduling_type=SchedulingType.NODE_AFFINITY,
                 node_row=row if row is not None else -1,
                 soft=spec.strategy.soft)
+        if kind is SchedulingStrategyKind.NODE_LABEL:
+            # resolve the selector into a node mask against live labels
+            mask = self.crm.label_mask(dict(spec.strategy.label_selector))
+            return SchedulingOptions(
+                scheduling_type=SchedulingType.NODE_LABEL,
+                node_mask=mask[:n_rows], soft=spec.strategy.soft)
         if kind is SchedulingStrategyKind.PLACEMENT_GROUP:
             # pin to the group's reserved bundles; a still-pending group
             # parks the task (all-False mask) until the PG manager's
@@ -503,6 +537,7 @@ class Raylet:
                         self._local_queue.remove(task_id)
                     except ValueError:
                         continue            # concurrent cancel removed it
+                    self._local_since.pop(task_id, None)
                     if rec is not None:
                         self._planned_add(rec.spec.resources, -1)
                 continue
@@ -530,7 +565,10 @@ class Raylet:
             worker = self.pool.pop_idle()
             if worker is None:
                 self.crm.add_back(self.row, spec.resources)
-                return                      # worker-limited: park
+                # worker-limited: park, but tasks that waited past the
+                # lease timeout spill back to global placement
+                self._spill_stale_leases()
+                return
             with self._cv:
                 try:
                     self._local_queue.remove(task_id)
@@ -538,6 +576,7 @@ class Raylet:
                     self.crm.add_back(self.row, spec.resources)
                     self.pool.release(worker)
                     continue
+                self._local_since.pop(task_id, None)
                 self._planned_add(spec.resources, -1)
             self._dispatch(worker, rec)
 
@@ -602,6 +641,7 @@ class Raylet:
         # lineage budget cost, measured here where the args are already
         # serialized (complete() must not re-pickle under the manager lock)
         rec.lineage_bytes = len(payload) + 256
+        self._task_start[spec.task_id.binary()] = time.time()
         worker.leased_task = spec.task_id.binary()
         with self._cv:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
@@ -618,26 +658,56 @@ class Raylet:
             return False
         return True
 
+    def _spill_stale_leases(self) -> None:
+        """Placed tasks that waited longer than ``worker_lease_timeout_ms``
+        for a worker re-enter GLOBAL placement (reference: an expired
+        worker-lease request is retried and may spill back to another
+        raylet).  Tasks with in-flight arg pulls stay (they are making
+        progress)."""
+        timeout = get_config().worker_lease_timeout_ms / 1000.0
+        now = time.monotonic()
+        moved = []
+        with self._cv:
+            if len(self.cluster.raylets) <= 1:
+                return          # nowhere to spill to
+            for tid in list(self._local_queue):
+                t0 = self._local_since.get(tid)
+                if t0 is None or now - t0 <= timeout or \
+                        tid in self._pull_pending:
+                    continue
+                self._local_queue.remove(tid)
+                self._local_since.pop(tid, None)
+                rec = self.task_manager.get(tid)
+                if rec is not None:
+                    self._planned_add(rec.spec.resources, -1)
+                # re-place AWAY from this starved node (reference:
+                # spillback excludes the rejecting raylet)
+                self._avoid_local.add(tid)
+                moved.append(tid)
+        for tid in moved:
+            self._enqueue(tid)
+
     def _requeue_after_worker_loss(self, rec, worker: WorkerHandle) -> None:
         self.crm.add_back(self.row, rec.spec.resources)
+        self._task_start.pop(rec.spec.task_id.binary(), None)
         worker.dead = True
         self._enqueue(rec.spec.task_id)
 
     def _fail_unscheduled(self, rec, message: str) -> None:
         """Fail a task that never reached dispatch (no resources were
         subtracted, no worker leased)."""
-        self.task_manager.complete(rec.spec.task_id)
         err = RayTaskError(rec.spec.function_descriptor, message)
-        for oid in rec.return_ids:
-            if oid not in rec.dead_returns:
+        for oid in rec.return_ids:           # seal before complete (see
+            if oid not in rec.dead_returns:  # _on_worker_message result)
                 self.store.put(oid, err)
+        self.task_manager.complete(rec.spec.task_id)
 
     def _finish_with_error(self, rec, error: RayTaskError,
                            worker: WorkerHandle | None) -> None:
-        self.task_manager.complete(rec.spec.task_id)
-        for oid in rec.return_ids:
-            if oid not in rec.dead_returns:
+        for oid in rec.return_ids:           # seal before complete (see
+            if oid not in rec.dead_returns:  # _on_worker_message result)
                 self.store.put(oid, error)
+        self.task_manager.complete(rec.spec.task_id)
         self.crm.add_back(self.row, rec.spec.resources)
         if worker is not None:
             self.pool.release(worker)
@@ -682,37 +752,27 @@ class Raylet:
                 return
             task_id, _, pinned = entry
             self.store.unpin(pinned)    # task done: release shm arg pins
-            rec = self.task_manager.complete(task_id)
-            if rec is not None:
+            rec = self.task_manager.get(task_id)
+            t0 = self._task_start.pop(task_id_bin, None)
+            if t0 is not None and rec is not None:
+                self.cluster.events.span(
+                    "task", rec.spec.function_descriptor[:16], t0,
+                    time.time(), self.row, worker=worker.proc.pid,
+                    status=kind)
+            if rec is not None and not rec.done:
+                # returns seal BEFORE complete(): a dropped ref whose
+                # decref folds mid-handler must see either a pending
+                # record (defer-to-seal) or a sealed object (reclaim now)
+                # — marking done first opens a window where the counter
+                # concludes the object will never seal and leaks it
                 if kind == "result":
-                    for oid, data in zip(rec.return_ids, msg[2]):
-                        if oid in rec.dead_returns:
-                            continue    # reclaimed while out of scope: a
-                            # re-seal would live forever (no refs remain
-                            # to ever decref it)
-                        # plasma-routed results are born on this node;
-                        # the location is registered BEFORE the seal (the
-                        # seal wakes dependent placement, which reads the
-                        # directory for locality)
-                        plasma = self.store.routes_to_plasma(len(data))
-                        if plasma:
-                            self.cluster.directory.add_location(oid,
-                                                                self.row)
-                        # size-routed: large payloads seal into the shared
-                        # arena (zero-copy reads), small ones in-band
-                        self.store.put_serialized(oid, data)
-                        if plasma and self.store.plasma_info(oid)[0] \
-                                not in ("shm", "spill"):
-                            # store-full in-band fallback: undo the
-                            # speculative directory entry
-                            self.cluster.directory.drop([oid])
-                        elif not plasma:
-                            self.cluster.register_location(oid, self.row)
+                    self._seal_results(rec, msg[2])
                 else:
                     err = deserialize(msg[2])
                     for oid in rec.return_ids:
                         if oid not in rec.dead_returns:
                             self.store.put(oid, err)
+                self.task_manager.complete(task_id)
                 self.crm.add_back(self.row, rec.spec.resources)
             self.pool.release(worker)
             self._notify_dirty()
@@ -778,8 +838,7 @@ class Raylet:
                          serialize([o.binary() for o in ready])))
         elif kind == "put":
             oid = self._oid(msg[1])
-            self.store.put_serialized(oid, msg[2])
-            self.cluster.register_location(oid, self.row)
+            self.cluster.seal_serialized(oid, msg[2], self.row)
         elif kind == "submit":
             spec = deserialize(msg[1])
             fn_id, fn_bytes = msg[2], msg[3]
@@ -802,6 +861,15 @@ class Raylet:
         elif kind == "pg_remove":
             from ..common.ids import PlacementGroupID
             self.cluster.pg_manager.remove(PlacementGroupID(msg[1]))
+
+    def _seal_results(self, rec, payloads) -> None:
+        """Seal a task's serialized return payloads (size-routed, with
+        pre-registered locations — ``Cluster.seal_serialized``)."""
+        for oid, data in zip(rec.return_ids, payloads):
+            if oid in rec.dead_returns:
+                continue        # reclaimed while out of scope: a re-seal
+                # would live forever (no refs remain to ever decref it)
+            self.cluster.seal_serialized(oid, data, self.row)
 
     def _send_get_reply(self, worker: WorkerHandle, oids, descs) -> None:
         """Ship get descriptors; shm descriptors were pinned by the store,
@@ -875,15 +943,12 @@ class Raylet:
 
     def _reacquire(self, resources: ResourceRequest,
                    patience: float = 5.0) -> None:
-        import time
-        deadline = time.monotonic() + patience
-        while not self.crm.subtract(self.row, resources):
-            if time.monotonic() >= deadline:
-                # oversubscribe rather than wedge: force the debit so the
-                # books stay balanced when the task completes
-                self.crm.force_subtract(self.row, resources)
-                return
-            time.sleep(0.002)
+        """Event-driven re-debit after a blocking get: parks on the CRM's
+        release condition (no polling); past ``patience`` it
+        oversubscribes rather than wedging — the matching add_back at task
+        completion rebalances."""
+        if not self.crm.wait_subtract(self.row, resources, patience):
+            self.crm.force_subtract(self.row, resources)
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
         self._drain_worker_pins(worker)
@@ -900,6 +965,7 @@ class Raylet:
             return
         task_id, _, pinned = entry
         self.store.unpin(pinned)
+        self._task_start.pop(task_id_bin, None)
         rec = self.task_manager.get(task_id)
         if rec is None:
             return
@@ -907,50 +973,52 @@ class Raylet:
         if self.task_manager.should_retry(task_id):
             self._enqueue(task_id)
         else:
-            self.task_manager.complete(task_id)
             err = RayTaskError(
                 rec.spec.function_descriptor,
                 "worker died", WorkerCrashedError(
                     f"worker {worker.index} died executing "
                     f"{rec.spec.function_descriptor}"))
-            for oid in rec.return_ids:
-                self.store.put(oid, err)
+            for oid in rec.return_ids:       # seal before complete (see
+                if oid not in rec.dead_returns:  # result handler)
+                    self.store.put(oid, err)
+            self.task_manager.complete(task_id)
         self._notify_dirty()
 
     # -- cancel / teardown --------------------------------------------------
+    def _cancel_seal_and_complete(self, task_id: TaskID) -> None:
+        """Seal the cancellation error, THEN mark done (seal-before-
+        complete, like the result handler)."""
+        from .serialization import TaskCancelledError
+        rec = self.task_manager.get(task_id)
+        if rec is None or rec.done:
+            return
+        err = RayTaskError(rec.spec.function_descriptor, "cancelled",
+                           TaskCancelledError())
+        for oid in rec.return_ids:
+            if oid not in rec.dead_returns:
+                self.store.put(oid, err)
+        self.task_manager.complete(task_id)
+
     def cancel(self, task_id: TaskID, force: bool = False) -> bool:
         from .serialization import TaskCancelledError
         with self._cv:
             if task_id in self._local_queue:
                 rec0 = self.task_manager.get(task_id)
                 self._local_queue.remove(task_id)
+                self._local_since.pop(task_id, None)
                 if rec0 is not None:
                     self._planned_add(rec0.spec.resources, -1)
-                rec = self.task_manager.complete(task_id)
-                if rec:
-                    err = RayTaskError(rec.spec.function_descriptor,
-                                       "cancelled", TaskCancelledError())
-                    for oid in rec.return_ids:
-                        self.store.put(oid, err)
+                self._cancel_seal_and_complete(task_id)
                 return True
             if task_id in self._queue:
                 self._queue.remove(task_id)
-                rec = self.task_manager.complete(task_id)
-                if rec:
-                    err = RayTaskError(rec.spec.function_descriptor,
-                                       "cancelled", TaskCancelledError())
-                    for oid in rec.return_ids:
-                        self.store.put(oid, err)
+                self._avoid_local.discard(task_id)
+                self._cancel_seal_and_complete(task_id)
                 return True
             if self._waiting.pop(task_id, None) is not None:
                 # dep-waiting: resolve its refs with the cancellation error
                 # (a later _dep_ready finds no entry and is a no-op)
-                rec = self.task_manager.complete(task_id)
-                if rec:
-                    err = RayTaskError(rec.spec.function_descriptor,
-                                       "cancelled", TaskCancelledError())
-                    for oid in rec.return_ids:
-                        self.store.put(oid, err)
+                self._cancel_seal_and_complete(task_id)
                 return True
             entry = self._running.get(task_id.binary())
         if entry is not None and force:
@@ -968,6 +1036,8 @@ class Raylet:
             queued = list(self._queue) + list(self._local_queue)
             self._queue.clear()
             self._local_queue.clear()
+            self._local_since.clear()
+            self._avoid_local.clear()
             running = list(self._running.items())
             self._running.clear()
             self._cv.notify_all()
@@ -989,12 +1059,13 @@ class Raylet:
                 rec = self.task_manager.get(task_id)
                 if rec is None:
                     continue
-                self.task_manager.complete(task_id)
                 err = RayTaskError(
                     rec.spec.function_descriptor, "node removed",
                     WorkerCrashedError("node died"))
-                for oid in rec.return_ids:
-                    self.store.put(oid, err)
+                for oid in rec.return_ids:   # seal before complete (see
+                    if oid not in rec.dead_returns:  # result handler)
+                        self.store.put(oid, err)
+                self.task_manager.complete(task_id)
         self.pool.shutdown()
 
     def stop(self) -> None:

@@ -76,6 +76,8 @@ class ReferenceCounter:
     def shutdown(self) -> None:
         self._stop = True
         self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     # -- reclaimer thread ----------------------------------------------------
     def _loop(self) -> None:

@@ -19,6 +19,7 @@ reference's local-node-first traversal when the local node registers first.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -34,6 +35,8 @@ class ClusterResourceManager:
     def __init__(self, num_resource_slots: int = 16,
                  capacity: int = 64):
         self._lock = threading.RLock()
+        # waiters parked on capacity (wait_subtract); add_back notifies
+        self._freed = threading.Condition(self._lock)
         self.resource_index = ResourceIndex()
         self._r_slots = max(num_resource_slots,
                             self.resource_index.num_resources)
@@ -158,6 +161,25 @@ class ClusterResourceManager:
             self.avail[row] = np.minimum(self.totals[row],
                                          self.avail[row] + vec)
             self.version += 1
+            self._freed.notify_all()
+
+    def wait_subtract(self, row: int, req: ResourceRequest,
+                      timeout: float) -> bool:
+        """Blocking subtract: parks on the release condition (no polling)
+        until the resources fit or ``timeout`` elapses.  Returns whether
+        the debit happened."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                vec = self._dense_req(req)
+                if (self.avail[row] >= vec).all():
+                    self.avail[row] -= vec
+                    self.version += 1
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._freed.wait(remaining)
 
     # -- bundle (placement-group) resource shaping --------------------------
     def add_shaped_resources(self, row: int, shaped_cu: dict[str, int]

@@ -31,6 +31,7 @@ class SchedulingType(enum.Enum):
     SPREAD = 1
     RANDOM = 2
     NODE_AFFINITY = 3
+    NODE_LABEL = 4
 
 
 @dataclass
@@ -58,9 +59,22 @@ class ISchedulingPolicy:
 
 
 class HybridSchedulingPolicy(ISchedulingPolicy):
-    """The default policy — contract.py semantics (SURVEY §2.5)."""
+    """The default policy — contract.py semantics (SURVEY §2.5).
+
+    Top-k sampling (reference ``scheduler_top_k_fraction`` /
+    ``scheduler_top_k_absolute``): with fraction > 0 the policy samples
+    uniformly among the k best-keyed feasible nodes instead of always
+    taking the minimum, trading determinism for contention spread.  The
+    stream is a pinned Philox counter (one draw per decision) so runs
+    replay bit-for-bit.  fraction = 0 (the default) is the
+    argmin/bit-exact-parity configuration; the device batch path requires
+    it (sampling rounds route through this host policy)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.Generator(np.random.Philox(seed))
 
     def schedule(self, state, req, options):
+        from ..common.config import get_config
         thr = threshold_fp(options.spread_threshold)
         mask = state.node_mask
         if options.node_mask is not None:
@@ -70,8 +84,12 @@ class HybridSchedulingPolicy(ISchedulingPolicy):
             mask = mask.copy()
             mask[options.local_node_row] = False
         keys = compute_keys(state.totals, state.avail, req, thr, mask)
-        node = int(np.argmin(keys))
-        if keys[node] == INFEASIBLE_KEY:
+        cfg = get_config()
+        if cfg.scheduler_top_k_fraction > 0:
+            node = self._sample_top_k(keys, cfg)
+        else:
+            node = int(np.argmin(keys))
+        if node < 0 or keys[node] == INFEASIBLE_KEY:
             return -1
         available = (keys[node] >> AVAIL_SHIFT) == 0
         if options.require_node_available and not available:
@@ -79,6 +97,17 @@ class HybridSchedulingPolicy(ISchedulingPolicy):
         if available:
             state.avail[node] -= np.asarray(req, dtype=np.int32)
         return node
+
+    def _sample_top_k(self, keys: np.ndarray, cfg) -> int:
+        feasible = np.flatnonzero(keys != INFEASIBLE_KEY)
+        if feasible.size == 0:
+            return -1
+        k = max(int(cfg.scheduler_top_k_absolute),
+                int(np.ceil(cfg.scheduler_top_k_fraction * feasible.size)))
+        k = min(k, feasible.size)
+        # the k best by packed key (ties broken by row index, like argmin)
+        order = feasible[np.argsort(keys[feasible], kind="stable")[:k]]
+        return int(self._rng.choice(order))
 
 
 class SpreadSchedulingPolicy(ISchedulingPolicy):
@@ -161,6 +190,26 @@ class NodeAffinitySchedulingPolicy(ISchedulingPolicy):
         return -1
 
 
+class NodeLabelSchedulingPolicy(ISchedulingPolicy):
+    """Restrict to nodes matching a label selector (resolved by the
+    caller into ``options.node_mask``), hybrid within the match set;
+    hard selectors with no matching node park (-1), soft ones fall back
+    to the unrestricted hybrid (reference
+    ``NodeLabelSchedulingPolicy`` hard/soft label constraints)."""
+
+    def __init__(self):
+        self._hybrid = HybridSchedulingPolicy()
+
+    def schedule(self, state, req, options):
+        node = self._hybrid.schedule(state, req, options)
+        if node >= 0 or not options.soft:
+            return node
+        fallback = SchedulingOptions(
+            scheduling_type=SchedulingType.HYBRID,
+            spread_threshold=options.spread_threshold)
+        return self._hybrid.schedule(state, req, fallback)
+
+
 class CompositeSchedulingPolicy(ISchedulingPolicy):
     """Dispatch on options.scheduling_type (reference
     ``CompositeSchedulingPolicy``)."""
@@ -171,6 +220,7 @@ class CompositeSchedulingPolicy(ISchedulingPolicy):
             SchedulingType.SPREAD: SpreadSchedulingPolicy(),
             SchedulingType.RANDOM: RandomSchedulingPolicy(seed),
             SchedulingType.NODE_AFFINITY: NodeAffinitySchedulingPolicy(),
+            SchedulingType.NODE_LABEL: NodeLabelSchedulingPolicy(),
         }
 
     def schedule(self, state, req, options):

@@ -13,8 +13,9 @@ from .placement_group import (PlacementGroup, placement_group,
                               placement_group_table,
                               remove_placement_group)
 from .scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                    NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy)
 
 __all__ = ["PlacementGroup", "placement_group", "placement_group_table",
            "remove_placement_group", "PlacementGroupSchedulingStrategy",
-           "NodeAffinitySchedulingStrategy"]
+           "NodeAffinitySchedulingStrategy", "NodeLabelSchedulingStrategy"]

@@ -16,7 +16,8 @@ from ..common.task_spec import SchedulingStrategy, SchedulingStrategyKind
 from .placement_group import PlacementGroup
 
 __all__ = ["PlacementGroupSchedulingStrategy",
-           "NodeAffinitySchedulingStrategy", "resolve_strategy"]
+           "NodeAffinitySchedulingStrategy",
+           "NodeLabelSchedulingStrategy", "resolve_strategy"]
 
 
 @dataclass
@@ -28,6 +29,16 @@ class PlacementGroupSchedulingStrategy:
 @dataclass
 class NodeAffinitySchedulingStrategy:
     node_id: NodeID
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Restrict placement to nodes whose labels match ``hard`` (all pairs
+    must match); ``soft=True`` falls back to any node when no labeled
+    node can take the task (reference
+    ``NodeLabelSchedulingStrategy(hard=..., soft=...)``)."""
+    hard: dict
     soft: bool = False
 
 
@@ -50,6 +61,11 @@ def resolve_strategy(value) -> SchedulingStrategy:
         return SchedulingStrategy(
             kind=SchedulingStrategyKind.NODE_AFFINITY,
             node_id=value.node_id, soft=value.soft)
+    if isinstance(value, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(
+            kind=SchedulingStrategyKind.NODE_LABEL,
+            label_selector=tuple(sorted(value.hard.items())),
+            soft=value.soft)
     if isinstance(value, SchedulingStrategy):
         return value
     raise TypeError(f"unsupported scheduling_strategy {value!r}")
