@@ -30,8 +30,8 @@ N_NODES = 1000
 N_RES = 8
 N_CLASSES = 64
 N_TASKS = 1_000_000
-ROUNDS = 10
-REPS = 5
+ROUNDS = 20         # rounds per timed repetition (amortizes the tunnel RTT)
+REPS = 9            # p50 over per-round means of these repetitions
 TARGET_MS = 50.0
 
 
@@ -95,15 +95,13 @@ def main():
     assert placed > N_TASKS // 2, f"only {placed}/{N_TASKS} placeable"
     assert sum(a.shape[0] for a in assignments[-1]) == N_TASKS
 
-    # bit-for-bit parity vs the CPU oracle (subset keeps oracle time sane)
+    # bit-for-bit parity vs the CPU oracle over the FULL 64-class batch
+    # (~3 s on host; the fixed-point short-cut in schedule_grouped_oracle
+    # keeps the O(G·N·R) loop cheap)
     from ray_tpu.scheduling import ClusterState, schedule_grouped_oracle
     st = ClusterState(totals.copy(), avail.copy(), node_mask.copy())
-    want = schedule_grouped_oracle(st, reqs[:4], counts[:4],
-                                   spread_threshold=0.5)
-    got = np.asarray(schedule_grouped(
-        args[0], args[1], args[2], d(reqs[:4]), d(counts[:4]),
-        jnp.ones((4, N_NODES), dtype=bool), jnp.int32(thr))[0])
-    parity = bool((got == want).all())
+    want = schedule_grouped_oracle(st, reqs, counts, spread_threshold=0.5)
+    parity = bool((np.asarray(hosts[-1]) == want).all())
 
     print(json.dumps({
         "metric": "p50 heartbeat time: 1M tasks x 1k nodes, bit-exact hybrid"

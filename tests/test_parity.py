@@ -110,3 +110,32 @@ def test_thousand_node_smoke():
     want = schedule_grouped_oracle(st, group_reqs, group_counts,
                                    spread_threshold=0.5)
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_full_scale_parity_1k_nodes_64_classes_1m_tasks():
+    """The north-star acceptance artifact at FULL scale: the exact
+    problem bench.py times (1k nodes x 64 classes x 1M tasks), device
+    batch vs sequential CPU oracle, bit-for-bit — plus the same scale
+    with random group masks and a spread threshold sweep."""
+    import sys
+    sys.path.insert(0, ".")
+    from bench import build_problem
+
+    totals, avail, node_mask, reqs, counts = build_problem()
+    from ray_tpu.scheduling import ClusterState
+    state = ClusterState(totals, avail, node_mask)
+    got = run_both(state, reqs, counts, 0.5)
+    assert int(got.sum()) == 1_000_000
+
+    # mixed group masks at scale (each class restricted to ~60% of nodes,
+    # the label/PG-mask shape at full width)
+    rng = np.random.default_rng(11)
+    masks = rng.random((reqs.shape[0], 1000)) < 0.6
+    run_both(ClusterState(totals, avail, node_mask), reqs,
+             counts, 0.5, masks)
+
+    # threshold sweep (pack-everything and spread-everything extremes)
+    for thr in (0.0, 1.01):
+        run_both(ClusterState(totals, avail, node_mask), reqs,
+                 counts, thr)
