@@ -31,11 +31,14 @@ def __getattr__(name):
     if name in _API_NAMES:
         from . import api
         return getattr(api, name)
-    if name == "util":
+    if name in ("util", "experimental"):
         # NOT `from . import util`: that re-enters __getattr__ via the
-        # fromlist hasattr probe before the submodule import finishes
+        # fromlist hasattr probe before the submodule import finishes.
+        # Only submodules that EXIST belong here — forwarding a missing
+        # name would turn hasattr()'s AttributeError contract into a
+        # ModuleNotFoundError escape.
         import importlib
-        return importlib.import_module(".util", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
 
 

@@ -148,7 +148,7 @@ class ActorClass:
             actor_id = ActorID.of(job_id)
         rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
                         max_restarts, max_task_retries, name, resources,
-                        strategy)
+                        strategy, opts.get("runtime_env"))
         return ActorHandle(actor_id)
 
 

@@ -114,12 +114,14 @@ class DriverRuntime:
 
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
-                     resources=None, strategy=None) -> None:
+                     resources=None, strategy=None,
+                     runtime_env=None) -> None:
         self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
                                         kwargs, max_restarts,
                                         max_task_retries, name,
                                         resources=resources,
-                                        strategy=strategy)
+                                        strategy=strategy,
+                                        runtime_env=runtime_env)
 
     def shutdown(self) -> None:
         # an adopted (caller-owned) cluster stays up across shutdown, the
@@ -145,7 +147,7 @@ class RemoteFunction:
                  name: str | None = None, num_returns: int = 1,
                  resources: dict[str, float] | None = None,
                  max_retries: int | None = None, fn_id: str | None = None,
-                 strategy=None):
+                 strategy=None, runtime_env: dict | None = None):
         if fn is None and fn_bytes is None and fn_id is None:
             raise ValueError("need a function, its bytes, or its id")
         self._fn = fn
@@ -155,6 +157,7 @@ class RemoteFunction:
         self._resources = dict(resources) if resources else {"CPU": 1}
         self._max_retries = max_retries
         self._strategy = strategy or DEFAULT_STRATEGY
+        self._runtime_env = runtime_env
         # The id is decoration-time random, NOT a content hash: a recursive
         # remote function's bytes contain its own wrapper, whose pickle
         # embeds the id — a content hash would be circular (reference keys
@@ -168,7 +171,8 @@ class RemoteFunction:
                 max_retries: int | None = None,
                 scheduling_strategy=None,
                 placement_group=None,
-                placement_group_bundle_index: int = -1) -> "RemoteFunction":
+                placement_group_bundle_index: int = -1,
+                runtime_env: dict | None = None) -> "RemoteFunction":
         res = dict(resources) if resources is not None \
             else dict(self._resources)
         if num_cpus is not None:
@@ -182,7 +186,9 @@ class RemoteFunction:
             res,
             max_retries if max_retries is not None else self._max_retries,
             fn_id=self._fn_id,     # same function => same registry entry
-            strategy=strategy)
+            strategy=strategy,
+            runtime_env=(runtime_env if runtime_env is not None
+                         else self._runtime_env))
 
     # -- serialization (registry + shipping) --------------------------------
     def _materialize(self) -> tuple[str, bytes | None]:
@@ -210,7 +216,7 @@ class RemoteFunction:
         return (RemoteFunction,
                 (None, None, self._name, self._num_returns,
                  self._resources, self._max_retries, self._fn_id,
-                 self._strategy))
+                 self._strategy, self._runtime_env))
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -244,7 +250,9 @@ class RemoteFunction:
             function_descriptor=fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns,
             resources=ResourceRequest(res),
-            strategy=self._strategy, max_retries=retries)
+            strategy=self._strategy, max_retries=retries,
+            runtime_env=self._runtime_env)  # the job-level env merges in
+        #                                     at the raylet submit intake
         # result refs are created BEFORE submission: the owner's refcount
         # must never dip to zero while the caller is still building them
         from .common.ids import ObjectID
@@ -275,7 +283,8 @@ def remote(*args, **options):
             strategy=_resolve_strategy_options(
                 options.get("scheduling_strategy"),
                 options.get("placement_group"),
-                options.get("placement_group_bundle_index", -1), None))
+                options.get("placement_group_bundle_index", -1), None),
+            runtime_env=options.get("runtime_env"))
     return wrap
 
 
@@ -326,10 +335,12 @@ def _normalize_resources(options: dict) -> dict[str, float]:
 def init(resources: dict[str, float] | None = None,
          num_workers: int | None = None,
          system_config: dict | None = None,
+         runtime_env: dict | None = None,
          cluster=None) -> None:
     """Start the runtime.  ``cluster=`` adopts an existing simulated
     multi-node ``cluster_utils.Cluster`` (the reference's
-    ``ray.init(address=cluster.address)`` pattern)."""
+    ``ray.init(address=cluster.address)`` pattern); ``runtime_env=`` is
+    the job-level default environment for every task."""
     global _runtime
     with _lock:
         if _runtime is not None:
@@ -345,6 +356,10 @@ def init(resources: dict[str, float] | None = None,
                 min(int(resources.get("CPU", ncpu)), ncpu)
         _runtime = DriverRuntime(JobID.next(), resources, num_workers,
                                  cluster=cluster)
+        # the cluster carries the job-level default env: EVERY spec
+        # intake (driver submits, worker-submitted children, actor
+        # creation) merges against it, so inheritance is uniform
+        _runtime.cluster.job_runtime_env = runtime_env
 
 
 def is_initialized() -> bool:

@@ -105,6 +105,13 @@ class Cluster:
         self.actor_manager = None             # attached by the runtime
         self.pg_manager = PlacementGroupManager(self)
         self.directory = ObjectDirectory()
+        # GCS control-plane siblings: namespaced KV + pubsub broker
+        from .runtime.kv_pubsub import KVStore, PubSub
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        from .runtime.runtime_env import RuntimeEnvManager
+        self.runtime_env_manager = RuntimeEnvManager(self.session_dir)
+        self.job_runtime_env = None           # set by api.init(runtime_env=)
         # node-bandwidth matrix (MB/s) — the pull cost model's input;
         # grows with the CRM row space
         self.bandwidth_mbps = np.zeros((0, 0), dtype=np.int32)
@@ -163,6 +170,8 @@ class Cluster:
         raylet.start()
         self.events.emit("node", "node_added", node_row=row,
                          node_id=node_id.hex(), resources=resources)
+        self.pubsub.publish("node", {"event": "added", "row": row,
+                                     "node_id": node_id.hex()})
         if wait and num_workers:
             raylet.pool.wait_ready(num_workers, timeout=60.0)
         # wake every existing raylet: tasks parked as infeasible may now
@@ -228,6 +237,8 @@ class Cluster:
             self.crm.remove_node(node_id)
         self.events.emit("node", "node_removed", node_row=row,
                          node_id=node_id.hex())
+        self.pubsub.publish("node", {"event": "removed", "row": row,
+                                     "node_id": node_id.hex()})
         lost = self.directory.on_node_removed(row)
         self.pull_manager.on_objects_lost(lost)
         from .runtime.serialization import RayTaskError

@@ -107,6 +107,12 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 0,
         "Worker pool size; 0 => os.cpu_count()."),
     "worker_lease_timeout_ms": (int, 10_000, "Lease RPC timeout."),
+    "env_worker_grace_ms": (
+        int, 50,
+        "How long a queued task waits for a busy same-env worker to "
+        "return before the pool grows a new env worker (cold starts "
+        "spawn immediately; growth past one worker per env costs one "
+        "grace period per worker)."),
     "actor_max_restarts_default": (int, 0, "Default max_restarts for actors."),
     "task_max_retries_default": (
         int, 3,

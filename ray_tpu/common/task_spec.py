@@ -77,6 +77,8 @@ class TaskSpec:
     strategy: SchedulingStrategy = DEFAULT_STRATEGY
     max_retries: int = 0
     actor_id: ActorID | None = None   # set for actor creation/actor tasks
+    # per-task runtime environment (env_vars/working_dir/py_modules/pip)
+    runtime_env: dict | None = None
     # lineage: object deps this spec needs (resolved by DependencyManager)
     dependencies: tuple = ()
     # retry bookkeeping (mutated by TaskManager)

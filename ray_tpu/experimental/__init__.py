@@ -1,0 +1,9 @@
+"""ray_tpu.experimental — internal/advanced APIs.
+
+Reference parity: ``ray.experimental`` hosts ``internal_kv`` (SURVEY.md
+§1 layer 3; mount empty).
+"""
+
+from . import internal_kv
+
+__all__ = ["internal_kv"]

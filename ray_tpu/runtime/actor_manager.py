@@ -65,6 +65,7 @@ class ActorRecord:
     resources: ResourceRequest = field(default_factory=ResourceRequest)
     strategy: SchedulingStrategy = field(
         default_factory=SchedulingStrategy)
+    runtime_env: dict | None = None
     state: ActorState = ActorState.PENDING
     worker = None
     pool = None                 # worker pool of the placement node
@@ -90,13 +91,17 @@ class ActorManager:
                      max_restarts: int, max_task_retries: int,
                      name: str | None = None,
                      resources: ResourceRequest | None = None,
-                     strategy: SchedulingStrategy | None = None) -> None:
+                     strategy: SchedulingStrategy | None = None,
+                     runtime_env: dict | None = None) -> None:
         if cls_bytes is not None:
             self._fn_registry.setdefault(cls_id, cls_bytes)
+        from .runtime_env import merge_runtime_env
         rec = ActorRecord(actor_id, cls_id, args, kwargs, max_restarts,
                           max_task_retries, name,
                           resources=resources or ResourceRequest(),
-                          strategy=strategy or SchedulingStrategy())
+                          strategy=strategy or SchedulingStrategy(),
+                          runtime_env=merge_runtime_env(
+                              self._cluster.job_runtime_env, runtime_env))
         rec.restarts_left = max_restarts
         with self._lock:
             if name is not None:
@@ -165,7 +170,45 @@ class ActorManager:
             return
         if not rec.resources.is_empty():
             crm.subtract(row, rec.resources)
-        worker = raylet.pool.spawn_dedicated()
+        if rec.runtime_env:
+            from .runtime_env import RuntimeEnvSetupError, env_key
+            try:
+                key = env_key(rec.runtime_env)
+                payload = self._cluster.runtime_env_manager.get_if_ready(
+                    key)
+            except (RuntimeEnvSetupError, ValueError) as e:
+                if not rec.resources.is_empty():
+                    crm.add_back(row, rec.resources)
+                self._on_incarnation_dead(
+                    rec.actor_id, init_error=RayTaskError(
+                        "actor ctor", f"runtime_env setup failed: {e}",
+                        ActorDiedError()))
+                return
+            if payload is None:
+                # provision off this thread: worker-submitted actor
+                # creation arrives on a pool READER thread, and a
+                # copytree there would stall every frame on the node
+                # (get replies, results).  Release the reservation and
+                # re-place once staged — the manager dedups concurrent
+                # stagers of one key.
+                if not rec.resources.is_empty():
+                    crm.add_back(row, rec.resources)
+
+                def provision() -> None:
+                    try:
+                        self._cluster.runtime_env_manager.stage(
+                            rec.runtime_env)
+                    except Exception:   # noqa: BLE001 — cached; the
+                        pass            # retry surfaces the failure
+                    self._start_incarnation(rec)
+                import threading
+                threading.Thread(target=provision, daemon=True,
+                                 name="actor-env-stage").start()
+                return
+            worker = raylet.pool.spawn_dedicated(
+                env_key=key, env_payload=payload)
+        else:
+            worker = raylet.pool.spawn_dedicated()
         worker.actor_binding = rec.actor_id
         with self._lock:
             rec.worker = worker
@@ -176,6 +219,13 @@ class ActorManager:
         worker.send(("fn", rec.cls_id, self._fn_registry[rec.cls_id]))
         worker.send(("actor_new", rec.actor_id.binary(), rec.cls_id,
                      payload))
+
+    def runtime_env_of(self, actor_id: ActorID) -> dict | None:
+        """The (job-merged) env an actor runs in — children it submits
+        inherit this (reference parent-inheritance semantics)."""
+        with self._lock:
+            rec = self._actors.get(actor_id)
+            return rec.runtime_env if rec is not None else None
 
     def _materialize_args(self, args: tuple) -> tuple:
         out = []

@@ -60,6 +60,12 @@ class Raylet:
         self._task_start: dict[bytes, float] = {}   # timeline spans
         self._round_durations: deque = deque(maxlen=256)    # metrics p50
         self._local_since: dict[TaskID, float] = {}  # lease-wait clocks
+        # first time a task missed pop_idle for its runtime env (grace
+        # for env-worker growth is measured from HERE, not queue entry —
+        # a task long-queued for unrelated reasons must still wait out
+        # the grace before the cache grows)
+        self._env_miss_since: dict[TaskID, float] = {}
+        self._env_staging: set[str] = set()     # env keys staging off-thread
         self._avoid_local: set[TaskID] = set()  # lease-spilled: skip here
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
@@ -83,6 +89,10 @@ class Raylet:
         submitting (owner-side refcounting — a transient ref made here
         and dropped would dip the count to zero and could reclaim the
         result under a caller that has not built its refs yet)."""
+        job_env = self.cluster.job_runtime_env
+        if job_env:
+            from .runtime_env import merge_runtime_env
+            spec.runtime_env = merge_runtime_env(job_env, spec.runtime_env)
         self.submit_existing(self.task_manager.register(spec))
 
     def submit_existing(self, rec) -> None:
@@ -525,6 +535,7 @@ class Raylet:
         misses = 0
         scanned = 0
         failed_classes: set = set()     # resource classes that cannot fit
+        env_missed: set = set()         # env keys already counted a miss
         while misses < max_misses:
             with self._cv:
                 if scanned >= len(self._local_queue):
@@ -538,6 +549,7 @@ class Raylet:
                     except ValueError:
                         continue            # concurrent cancel removed it
                     self._local_since.pop(task_id, None)
+                    self._env_miss_since.pop(task_id, None)
                     if rec is not None:
                         self._planned_add(rec.spec.resources, -1)
                 continue
@@ -562,13 +574,28 @@ class Raylet:
                 misses += 1
                 scanned += 1
                 continue
-            worker = self.pool.pop_idle()
-            if worker is None:
-                self.crm.add_back(self.row, spec.resources)
-                # worker-limited: park, but tasks that waited past the
-                # lease timeout spill back to global placement
-                self._spill_stale_leases()
-                return
+            if spec.runtime_env:
+                worker, env_k = self._pop_env_worker(task_id, rec, spec)
+                if worker is None:
+                    # one miss per env KEY per scan (like failed_classes
+                    # for resources): a block of same-env tasks parked
+                    # at a barrier must not eat the whole miss budget
+                    # and starve runnable default tasks behind them
+                    if env_k is None or env_k not in env_missed:
+                        misses += 1
+                        if env_k is not None:
+                            env_missed.add(env_k)
+                    scanned += 1
+                    continue    # this task waits for its env worker (or
+                    # failed staging); others may still dispatch
+            else:
+                worker = self.pool.pop_idle()
+                if worker is None:
+                    self.crm.add_back(self.row, spec.resources)
+                    # worker-limited: park, but tasks that waited past the
+                    # lease timeout spill back to global placement
+                    self._spill_stale_leases()
+                    return
             with self._cv:
                 try:
                     self._local_queue.remove(task_id)
@@ -577,6 +604,7 @@ class Raylet:
                     self.pool.release(worker)
                     continue
                 self._local_since.pop(task_id, None)
+                self._env_miss_since.pop(task_id, None)
                 self._planned_add(spec.resources, -1)
             self._dispatch(worker, rec)
 
@@ -658,6 +686,106 @@ class Raylet:
             return False
         return True
 
+    def _pop_env_worker(self, task_id, rec, spec):
+        """Lease a worker matching the task's runtime env, staging the
+        env off-thread and spawning a cached env worker on first need
+        (reference: the runtime-env agent provisions, then the lease
+        retries).  Returns ``(worker_or_None, env_key_or_None)``: a None
+        worker means the task cannot dispatch this round (env worker
+        busy/booting/staging, or staging failed and the task was sealed
+        with RuntimeEnvSetupError); the key lets the scan dedup misses
+        per env."""
+        from .runtime_env import RuntimeEnvSetupError, env_key
+        try:
+            key = env_key(spec.runtime_env)
+            payload = self.cluster.runtime_env_manager.get_if_ready(key)
+        except (RuntimeEnvSetupError, ValueError) as e:
+            with self._cv:
+                try:
+                    self._local_queue.remove(task_id)
+                except ValueError:
+                    self.crm.add_back(self.row, spec.resources)
+                    return None, None
+                self._local_since.pop(task_id, None)
+                self._env_miss_since.pop(task_id, None)
+                self._planned_add(spec.resources, -1)
+            self._finish_with_error(rec, RayTaskError(
+                spec.function_descriptor, f"runtime_env setup failed: {e}",
+                e if isinstance(e, RuntimeEnvSetupError)
+                else RuntimeEnvSetupError(str(e))), None)
+            return None, None
+        if payload is None:
+            # unstaged: provision on a side thread — a copytree of a
+            # large working_dir on THIS thread would stall every other
+            # task's dispatch on the node (reference: the runtime-env
+            # agent keeps staging off the raylet's dispatch path)
+            self._stage_env_async(key, spec.runtime_env)
+            self.crm.add_back(self.row, spec.resources)
+            return None, key
+        worker = self.pool.pop_idle(key)
+        if worker is None:
+            # cold start (no worker staged into this env) spawns now;
+            # otherwise wait out a grace period first — the busy worker
+            # normally returns to idle in microseconds (sequential
+            # reuse), but tasks that rendezvous with each other (a
+            # barrier under a job-level env) hold their workers, and
+            # only growing the cache un-deadlocks them
+            now = time.monotonic()
+            grace = get_config().env_worker_grace_ms / 1000.0
+            with self._cv:
+                first = self._env_miss_since.setdefault(task_id, now)
+            if self.pool.live_env_workers(key) == 0 or now - first > grace:
+                with self._cv:
+                    # a fresh grace gates the NEXT growth step: without
+                    # this re-stamp, every scan after the first lapse
+                    # would fork another process
+                    self._env_miss_since[task_id] = now
+                self.pool.ensure_env_worker(key, payload)
+            elif first == now:
+                # first miss: nothing else re-triggers the scan if the
+                # busy worker never returns, so arm ONE re-check timer
+                # per waiting task for just past the grace
+                t = threading.Timer(grace * 1.1, self._notify_dirty)
+                t.daemon = True
+                t.start()
+            self.crm.add_back(self.row, spec.resources)
+        return worker, key
+
+    def _parent_env_of(self, worker: WorkerHandle) -> dict | None:
+        """The runtime env of whatever this worker is executing: its
+        leased task's (job-merged) env, or its bound actor's."""
+        tid_bin = worker.leased_task
+        if tid_bin is not None:
+            entry = self._running.get(tid_bin)
+            if entry is not None:
+                rec = self.task_manager.get(entry[0])
+                if rec is not None:
+                    return rec.spec.runtime_env
+        actor_id = getattr(worker, "actor_binding", None)
+        if actor_id is not None and self.actor_manager is not None:
+            return self.actor_manager.runtime_env_of(actor_id)
+        return None
+
+    def _stage_env_async(self, key: str, env: dict) -> None:
+        """Provision a runtime env on a daemon thread, once per key;
+        completion (or the now-cached failure) re-wakes the scan."""
+        with self._cv:
+            if key in self._env_staging:
+                return
+            self._env_staging.add(key)
+
+        def run() -> None:
+            try:
+                self.cluster.runtime_env_manager.stage(env)
+            except Exception:   # noqa: BLE001 — the manager caches the
+                pass            # error; the next scan fails the task
+            finally:
+                with self._cv:
+                    self._env_staging.discard(key)
+                self._notify_dirty()
+        threading.Thread(target=run, daemon=True,
+                         name=f"env-stage-{key[:8]}").start()
+
     def _spill_stale_leases(self) -> None:
         """Placed tasks that waited longer than ``worker_lease_timeout_ms``
         for a worker re-enter GLOBAL placement (reference: an expired
@@ -677,6 +805,7 @@ class Raylet:
                     continue
                 self._local_queue.remove(tid)
                 self._local_since.pop(tid, None)
+                self._env_miss_since.pop(tid, None)
                 rec = self.task_manager.get(tid)
                 if rec is not None:
                     self._planned_add(rec.spec.resources, -1)
@@ -723,10 +852,18 @@ class Raylet:
             if kind == "actor_create":
                 from ..common.ids import ActorID
                 (args, kwargs, max_restarts, max_task_retries, name, res,
-                 strategy) = deserialize(msg[4])
+                 strategy, runtime_env) = deserialize(msg[4])
+                parent_env = self._parent_env_of(worker)
+                if parent_env:
+                    # worker-created actors inherit the creating
+                    # task/actor's env, like child tasks do
+                    from .runtime_env import merge_runtime_env
+                    runtime_env = merge_runtime_env(parent_env,
+                                                    runtime_env)
                 am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
                                 kwargs, max_restarts, max_task_retries,
-                                name, resources=res, strategy=strategy)
+                                name, resources=res, strategy=strategy,
+                                runtime_env=runtime_env)
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
@@ -850,7 +987,14 @@ class Raylet:
             # results the worker still needs.  Worker-held objects are
             # simply never auto-reclaimed (conservative leak, reference
             # borrower protocol's in-process simplification).
-            self.submit_existing(self.task_manager.register(spec))
+            parent_env = self._parent_env_of(worker)
+            if parent_env:
+                # children inherit their PARENT task/actor's env, not
+                # just the job's (reference inheritance semantics)
+                from .runtime_env import merge_runtime_env
+                spec.runtime_env = merge_runtime_env(parent_env,
+                                                     spec.runtime_env)
+            self.submit(spec)   # shares the job-env merge intake
         elif kind == "pg_create":
             from ..common.ids import PlacementGroupID
             from ..scheduling.bundles import PlacementStrategy
@@ -861,6 +1005,19 @@ class Raylet:
         elif kind == "pg_remove":
             from ..common.ids import PlacementGroupID
             self.cluster.pg_manager.remove(PlacementGroupID(msg[1]))
+        elif kind == "kv":
+            # ("kv", op, key, value, namespace, overwrite)
+            #   -> ("kv_reply", result, error_or_None)
+            # A reply goes back even on failure: the worker blocks in
+            # _recv_reply with no timeout, so a swallowed exception here
+            # (bad value type, unknown op) would wedge it forever.
+            try:
+                result = self.cluster.kv.dispatch(
+                    msg[1], msg[2], msg[3], msg[4], msg[5])
+                worker.send(("kv_reply", result, None))
+            except Exception as e:      # noqa: BLE001
+                worker.send(("kv_reply", None,
+                             f"{type(e).__name__}: {e}"))
 
     def _seal_results(self, rec, payloads) -> None:
         """Seal a task's serialized return payloads (size-routed, with
@@ -1006,6 +1163,7 @@ class Raylet:
                 rec0 = self.task_manager.get(task_id)
                 self._local_queue.remove(task_id)
                 self._local_since.pop(task_id, None)
+                self._env_miss_since.pop(task_id, None)
                 if rec0 is not None:
                     self._planned_add(rec0.spec.resources, -1)
                 self._cancel_seal_and_complete(task_id)
@@ -1037,6 +1195,7 @@ class Raylet:
             self._queue.clear()
             self._local_queue.clear()
             self._local_since.clear()
+            self._env_miss_since.clear()
             self._avoid_local.clear()
             running = list(self._running.items())
             self._running.clear()

@@ -12,6 +12,7 @@ mount empty).
 from __future__ import annotations
 
 import threading
+import time
 
 from ..common.ids import ObjectID
 from ..common.task_spec import TaskType
@@ -30,11 +31,31 @@ class ObjectRecoveryManager:
         task.  Returns True when a reconstruction is (already) in flight —
         the object will re-seal and waiters wake; False when the object is
         unrecoverable (caller poisons it)."""
+        self._await_completion_window(object_id)     # BEFORE the lock:
+        # this can wait up to 2s, and holding the manager lock through it
+        # would serialize recoveries of unrelated objects behind it
         with self._lock:
             ok = self._recover_locked(object_id)
         if not ok:
             self.num_unrecoverable += 1
         return ok
+
+    def _await_completion_window(self, object_id: ObjectID) -> None:
+        """Seal-to-complete window: the producer ALREADY delivered this
+        object (seal precedes complete) and the completion is mid-flight
+        on a reader thread — nothing in flight will re-seal.  Wait for
+        done (normally microseconds) so recovery takes the normal
+        retained-lineage path; treating this as "first execution in
+        flight" would delete the sealed value and strand every waiter."""
+        if object_id.is_put():
+            return
+        rec = self._cluster.task_manager.get(object_id.task_id())
+        if rec is None:
+            return
+        deadline = time.monotonic() + 2.0
+        while (not rec.done and self._cluster.store.contains(object_id)
+               and time.monotonic() < deadline):
+            time.sleep(0.0005)
 
     def _recover_locked(self, object_id: ObjectID) -> bool:
         if object_id.is_put():

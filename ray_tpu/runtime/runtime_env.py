@@ -1,0 +1,234 @@
+"""Runtime environments: per-task/actor worker environment provisioning.
+
+Reference parity: ``python/ray/_private/runtime_env/`` — a per-node agent
+stages ``runtime_env`` resources (working_dir/py_modules URIs into a
+local cache, pip/conda environments), workers start inside the staged
+environment, and staged URIs are cached/reference-counted per node
+(SURVEY.md §1 layer 10; mount empty).
+
+In-process form: the ``RuntimeEnvManager`` stages into
+``<session>/runtime_resources/<digest>/`` (content-addressed cache, the
+URI-cache analogue) and produces a *payload* the spawned worker applies
+at startup (env vars, chdir into the staged working_dir, sys.path for
+py_modules).  ``pip``/``conda`` requests are validated against the
+already-present interpreter environment — this deployment is
+zero-egress, so a requirement that is not importable fails staging with
+``RuntimeEnvSetupError`` (the reference surfaces the same error type
+when provisioning fails).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+
+from .serialization import RayError
+
+
+class RuntimeEnvSetupError(RayError):
+    """Staging a runtime_env failed (reference:
+    ``ray.exceptions.RuntimeEnvSetupError``)."""
+
+
+_ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+
+
+def normalize(env: dict | None) -> tuple | None:
+    """Canonical hashable form (the worker-pool cache key).  Raises
+    ValueError for ANY malformed env — including non-JSON values, which
+    json.dumps reports as TypeError: callers catch ValueError to fail
+    the task, and an uncaught TypeError after resource admission would
+    leak the reservation every scheduling round."""
+    if not env:
+        return None
+    unknown = set(env) - _ALLOWED_KEYS
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
+    try:
+        return tuple(sorted(
+            (k, json.dumps(env[k], sort_keys=True)) for k in env))
+    except TypeError as e:
+        raise ValueError(f"runtime_env is not JSON-serializable: {e}") \
+            from e
+
+
+def env_key(env: dict | None) -> str | None:
+    norm = normalize(env)
+    if norm is None:
+        return None
+    return hashlib.sha256(repr(norm).encode()).hexdigest()[:16]
+
+
+def merge_runtime_env(job_env: dict | None,
+                      task_env: dict | None) -> dict | None:
+    """Task/actor env over job env; ``env_vars`` merge key-wise
+    (reference runtime_env inheritance semantics).  Idempotent, so it is
+    safe for a spec to cross more than one merge point."""
+    if not job_env:
+        return task_env
+    if not task_env:
+        return job_env
+    merged = {**job_env, **task_env}
+    if "env_vars" in job_env or "env_vars" in task_env:
+        merged["env_vars"] = {**(job_env.get("env_vars") or {}),
+                              **(task_env.get("env_vars") or {})}
+    return merged
+
+
+class RuntimeEnvManager:
+    def __init__(self, session_dir: str):
+        self._root = os.path.join(session_dir, "runtime_resources")
+        self._lock = threading.Lock()
+        self._cache: dict[str, dict] = {}       # key -> staged payload
+        self._errors: dict[str, str] = {}       # key -> staging error
+        self._inflight: dict[str, threading.Event] = {}  # key -> staging
+        self.num_staged = 0
+
+    def get_if_ready(self, key: str | None) -> dict | None:
+        """Cached payload for an env key, or None while unstaged/staging
+        (the raylet's non-blocking dispatch probe).  Raises the cached
+        RuntimeEnvSetupError for a known-bad env."""
+        if key is None:
+            return None
+        with self._lock:
+            if key in self._errors:
+                raise RuntimeEnvSetupError(self._errors[key])
+            return self._cache.get(key)
+
+    def stage(self, env: dict | None) -> dict | None:
+        """Stage (or fetch from cache) a runtime_env.  Returns the worker
+        payload ``{"env_vars", "working_dir", "py_modules"}`` or None for
+        the empty env.  Raises RuntimeEnvSetupError on failure (cached:
+        repeated submissions fail fast like the reference's agent).
+        Concurrent stagers of the same key wait for the first — two
+        copytrees into one destination would hand a worker a
+        half-written tree."""
+        key = env_key(env)
+        if key is None:
+            return None
+        while True:
+            with self._lock:
+                if key in self._errors:
+                    raise RuntimeEnvSetupError(self._errors[key])
+                cached = self._cache.get(key)
+                if cached is not None:
+                    return cached
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[key] = ev
+                    break
+            ev.wait()       # another thread is staging this key
+        try:
+            payload = self._stage_fresh(key, env)
+        except Exception as e:
+            # EVERY failure is cached and surfaced as a setup error —
+            # an uncached OSError (copytree, disk full) would otherwise
+            # send the async staging path into a re-stage loop
+            msg = str(e) if isinstance(e, RuntimeEnvSetupError) \
+                else f"{type(e).__name__}: {e}"
+            with self._lock:
+                self._errors[key] = msg
+            raise RuntimeEnvSetupError(msg) from e
+        else:
+            with self._lock:
+                self._cache[key] = payload
+                self.num_staged += 1
+            return payload
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()
+
+    def _stage_fresh(self, key: str, env: dict) -> dict:
+        payload: dict = {"env_vars": dict(env.get("env_vars") or {}),
+                         "working_dir": None, "py_modules": []}
+        for k, v in payload["env_vars"].items():
+            if not isinstance(k, str) or not isinstance(v, str):
+                raise RuntimeEnvSetupError(
+                    f"env_vars must be str->str, got {k!r}: {v!r}")
+        self._check_requirements(env)
+        stage_dir = os.path.join(self._root, key)
+        wd = env.get("working_dir")
+        if wd:
+            if not os.path.isdir(wd):
+                raise RuntimeEnvSetupError(
+                    f"working_dir {wd!r} does not exist")
+            dst = os.path.join(stage_dir, "working_dir")
+            if not os.path.isdir(dst):
+                shutil.copytree(wd, dst, dirs_exist_ok=True)
+            payload["working_dir"] = dst
+        for mod in env.get("py_modules") or []:
+            if not os.path.exists(mod):
+                raise RuntimeEnvSetupError(
+                    f"py_modules entry {mod!r} does not exist")
+            name = os.path.basename(mod.rstrip("/"))
+            dst = os.path.join(stage_dir, "py_modules", name)
+            if not os.path.exists(dst):
+                if os.path.isdir(mod):
+                    shutil.copytree(mod, dst, dirs_exist_ok=True)
+                else:
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(mod, dst)
+            # both shapes import from the staging dir: it is the parent
+            # of a copied package dir and the holder of a copied file
+            payload["py_modules"].append(os.path.dirname(dst))
+        return payload
+
+    def _check_requirements(self, env: dict) -> None:
+        """Zero-egress pip/conda: requirements must already be present in
+        the interpreter environment.  Checked against the DISTRIBUTION
+        namespace first (pip requirements name distributions, and import
+        names can differ: scikit-learn/sklearn, pillow/PIL), with an
+        import-name probe as fallback."""
+        import importlib.util
+        import re
+        from importlib import metadata
+        reqs = list(env.get("pip") or [])
+        conda = env.get("conda")
+        if isinstance(conda, dict):
+            reqs += [d for d in conda.get("dependencies", ())
+                     if isinstance(d, str)]
+        for req in reqs:
+            name = re.split(r"[=<>!~\[;\s]", req.strip(), 1)[0]
+            try:
+                metadata.version(name)
+                continue
+            except metadata.PackageNotFoundError:
+                pass
+            try:
+                found = importlib.util.find_spec(
+                    name.replace("-", "_")) is not None
+            except (ImportError, ValueError):
+                found = False
+            if not found:
+                raise RuntimeEnvSetupError(
+                    f"runtime_env requirement {req!r} is not installed "
+                    "and this deployment has no package egress "
+                    "(pip/conda provisioning is validation-only here)")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"num_staged": self.num_staged,
+                    "num_cached": len(self._cache),
+                    "num_failed": len(self._errors)}
+
+
+def apply_payload(payload: dict | None) -> None:
+    """Worker-side: enter the staged environment (env vars, working dir,
+    module paths) before executing any task."""
+    if not payload:
+        return
+    import sys
+    os.environ.update(payload.get("env_vars") or {})
+    for p in payload.get("py_modules") or []:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    wd = payload.get("working_dir")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)

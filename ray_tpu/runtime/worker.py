@@ -141,14 +141,24 @@ class WorkerApiContext:
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
         self._conn.send(("submit", serialize(spec), fn_id, fn_bytes))
 
+    def kv_op(self, op: str, key: bytes, value: bytes | None = None,
+              namespace: str = "", overwrite: bool = True):
+        """GCS KV access from inside a task (internal_kv parity)."""
+        self._conn.send(("kv", op, key, value, namespace, overwrite))
+        reply = self._recv_reply("kv_reply")
+        if reply[2] is not None:
+            raise RuntimeError(f"internal_kv {op} failed: {reply[2]}")
+        return reply[1]
+
     # -- actor API (frames handled by the driver's ActorManager) ------------
     def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
                      args, kwargs, max_restarts: int, max_task_retries: int,
-                     name: str | None, resources=None, strategy=None):
+                     name: str | None, resources=None, strategy=None,
+                     runtime_env=None):
         self._conn.send(("actor_create", actor_id.binary(), cls_id,
                          cls_bytes, serialize(
                              (args, kwargs, max_restarts, max_task_retries,
-                              name, resources, strategy))))
+                              name, resources, strategy, runtime_env))))
 
     # -- placement groups (frames handled by the raylet) --------------------
     def create_placement_group(self, pg_id, bundles, strategy_name: str,
@@ -174,11 +184,15 @@ class WorkerApiContext:
 
 
 def worker_main(conn, worker_index: int,
-                arena_path: str | None = None) -> None:
+                arena_path: str | None = None,
+                runtime_env_payload: dict | None = None) -> None:
     """Entry point of a spawned worker process."""
     # workers never own the TPU: the device data plane belongs to the
     # raylet/driver process; user task code that imports jax gets CPU
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # enter the staged runtime environment BEFORE any user code runs
+    from .runtime_env import apply_payload
+    apply_payload(runtime_env_payload)
 
     from .. import api
 

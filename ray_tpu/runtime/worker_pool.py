@@ -35,6 +35,8 @@ class WorkerHandle:
         self.dead = False
         self.blocked = False                # inside a blocking get
         self.dedicated = False              # actor worker: never in idle set
+        self.env_key = None                 # runtime-env cache key
+        self.env_payload = None             # staged payload (respawn)
         self.leased_task = None             # task_id_bin while executing
         self.fn_cache: set[str] = set()
         # FIFO of shm-pin batches for get replies in flight to this
@@ -78,13 +80,20 @@ class WorkerPool:
         self._idle: list[WorkerHandle] = []
         self._next_index = 0
         self._shutdown = False
+        # env keys with a spawn in flight -> owning worker index (-1
+        # while the claim predates its handle).  Ownership matters: a
+        # death-respawn of a post-ready env worker runs OUTSIDE the
+        # gate, and its ready must not release a gate a concurrent
+        # ensure_env_worker spawn still holds
+        self._env_spawning: dict = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         for _ in range(self._num):
             self._spawn_one()
 
-    def _spawn_one(self, dedicated: bool = False) -> WorkerHandle | None:
+    def _spawn_one(self, dedicated: bool = False, env_key=None,
+                   env_payload: dict | None = None) -> WorkerHandle | None:
         with self._lock:
             if self._shutdown:
                 return None
@@ -97,7 +106,8 @@ class WorkerPool:
             try:
                 proc = self._ctx.Process(
                     target=worker_main,
-                    args=(child_conn, index, self._arena_path),
+                    args=(child_conn, index, self._arena_path,
+                          env_payload),
                     daemon=True, name=f"rt-worker-{index}")
                 proc.start()
             finally:
@@ -105,20 +115,64 @@ class WorkerPool:
         child_conn.close()
         handle = WorkerHandle(index, proc, parent_conn)
         handle.dedicated = dedicated
+        handle.env_key = env_key
+        handle.env_payload = env_payload
         with self._lock:
             self._workers.append(handle)
+            # an unowned gate claim (-1) for this key becomes ours; the
+            # key stays gated until the worker signals READY (see
+            # _reader) — releasing at proc.start() would let every
+            # scheduler scan during the worker's multi-hundred-ms boot
+            # fork yet another process
+            if (not dedicated and env_key is not None
+                    and self._env_spawning.get(env_key) == -1):
+                self._env_spawning[env_key] = handle.index
         threading.Thread(target=self._reader, args=(handle,),
                          daemon=True, name=f"rt-reader-{index}").start()
         return handle
 
-    def spawn_dedicated(self) -> WorkerHandle:
+    def spawn_dedicated(self, env_key=None,
+                        env_payload: dict | None = None) -> WorkerHandle:
         """Spawn a worker that is never leased from the idle set — the
         dedicated actor-worker model (reference: each actor gets its own
-        worker process)."""
-        handle = self._spawn_one(dedicated=True)
+        worker process), optionally inside a staged runtime env."""
+        handle = self._spawn_one(dedicated=True, env_key=env_key,
+                                 env_payload=env_payload)
         if handle is None:
             raise RuntimeError("pool is shut down")
         return handle
+
+    def ensure_env_worker(self, env_key, env_payload: dict) -> None:
+        """Grow the per-env worker cache by one (single spawn in flight
+        per key).  WHEN to grow is the raylet's call — a one-per-env
+        cache deadlocks when tasks sharing an env block on each other (a
+        barrier under a job-level runtime_env), while unconditional
+        growth double-spawns on sequential reuse, so the raylet spawns
+        immediately only on cold start and otherwise after a grace
+        period (``env_worker_grace_ms``)."""
+        with self._lock:
+            if env_key in self._env_spawning:
+                return
+            self._env_spawning[env_key] = -1    # claimed; spawn next
+        try:
+            self._spawn_one(env_key=env_key, env_payload=env_payload)
+        except Exception:
+            # a failed fork must not wedge the gate: future scans retry
+            with self._lock:
+                if self._env_spawning.get(env_key) == -1:
+                    del self._env_spawning[env_key]
+            raise
+
+    def live_env_workers(self, env_key) -> int:
+        """Leasable workers staged into this env (idle or busy, not
+        dedicated to an actor), plus any spawn in flight."""
+        with self._lock:
+            n = sum(1 for h in self._workers
+                    if h.env_key == env_key and not h.dead
+                    and not h.dedicated)
+            if env_key in self._env_spawning:
+                n += 1
+            return n
 
     def _reader(self, handle: WorkerHandle) -> None:
         while True:
@@ -127,6 +181,14 @@ class WorkerPool:
             except (EOFError, OSError):
                 break
             if msg[0] == "ready":
+                if not handle.dedicated and handle.env_key is not None:
+                    with self._lock:
+                        # boot done: reopen the env gate — but only OUR
+                        # claim; a death-respawn's ready must not free a
+                        # gate a concurrent ensure spawn still holds
+                        if self._env_spawning.get(handle.env_key) \
+                                == handle.index:
+                            del self._env_spawning[handle.env_key]
                 with self._cv:
                     handle.ready = True
                     if not handle.dedicated:
@@ -148,14 +210,40 @@ class WorkerPool:
         if not self._shutdown:
             self._on_death(handle)
             if not handle.dedicated:
-                self._spawn_one()           # keep the task pool at strength
+                # keep the pool at strength; env workers respawn into
+                # their staged environment.  A worker that died MID-BOOT
+                # still owns its gate claim: hand the claim to the
+                # replacement (back to -1, which the respawned _spawn_one
+                # re-claims) so the gate reopens at the replacement's
+                # ready — or here, on spawn failure
+                if handle.env_key is not None:
+                    with self._lock:
+                        if self._env_spawning.get(handle.env_key) \
+                                == handle.index:
+                            self._env_spawning[handle.env_key] = -1
+                try:
+                    self._spawn_one(env_key=handle.env_key,
+                                    env_payload=handle.env_payload)
+                except Exception:
+                    if handle.env_key is not None:
+                        with self._lock:
+                            if self._env_spawning.get(handle.env_key) \
+                                    == -1:
+                                del self._env_spawning[handle.env_key]
+                    raise
 
     # -- leasing ------------------------------------------------------------
-    def pop_idle(self) -> WorkerHandle | None:
+    def pop_idle(self, env_key=None) -> WorkerHandle | None:
+        """Lease an idle worker whose runtime env matches ``env_key``
+        (None = the default environment)."""
         with self._cv:
-            while self._idle:
-                h = self._idle.pop()
-                if not h.dead:
+            for i in range(len(self._idle) - 1, -1, -1):
+                h = self._idle[i]
+                if h.dead:
+                    del self._idle[i]
+                    continue
+                if h.env_key == env_key:
+                    del self._idle[i]
                     return h
             return None
 
@@ -189,15 +277,22 @@ class WorkerPool:
         return self._num
 
     def grow_for_blocked(self, max_factor: int = 4) -> bool:
-        """Spawn one extra worker when the pool is starved by workers
-        parked in a blocking get (reference: workers blocked in ray.get
-        stop counting toward the soft limit, and the pool starts
-        replacements on demand — SURVEY §3.2 lease notes)."""
+        """Spawn one extra DEFAULT worker when the pool is starved by
+        workers parked in a blocking get (reference: workers blocked in
+        ray.get stop counting toward the soft limit, and the pool starts
+        replacements on demand — SURVEY §3.2 lease notes).  Env workers
+        are excluded from every count here: an idle env worker cannot be
+        leased by a default task (pop_idle is env-keyed), so it must not
+        suppress growth, and env-cache growth has its own demand-driven
+        path (``ensure_env_worker``)."""
         with self._lock:
             alive = [h for h in self._workers
-                     if not h.dead and not h.dedicated]
+                     if not h.dead and not h.dedicated
+                     and h.env_key is None]
             unblocked = sum(not h.blocked for h in alive)
-            if self._idle or unblocked >= self._num \
+            idle_default = any(not h.dead and h.env_key is None
+                               for h in self._idle)
+            if idle_default or unblocked >= self._num \
                     or len(alive) >= self._num * max_factor:
                 return False
         self._spawn_one()

@@ -1,0 +1,366 @@
+"""Collective communication — ``ray.util.collective`` rebuilt TPU-first.
+
+Reference parity: ``python/ray/util/collective/`` — named groups created
+with ``init_collective_group(world_size, rank, backend, group_name)``,
+then ``allreduce/allgather/reducescatter/broadcast/barrier/send/recv``;
+NCCL backend for device tensors, Gloo for host tensors (SURVEY.md §1
+layer 13; mount empty).
+
+Two backends, both real:
+
+- **Device mesh (the NCCL analogue, TPU-first)**: collectives over this
+  host's accelerator devices as ONE compiled XLA program —
+  ``shard_map`` over a ``jax.sharding.Mesh`` with ``lax.psum`` /
+  ``all_gather`` / ``psum_scatter`` / ``ppermute``, so the transfers
+  ride ICI and fuse with surrounding compute instead of translating
+  NCCL ringcalls.  ``DeviceCollectiveGroup`` below.
+
+- **Process group (the Gloo analogue)**: named groups spanning worker
+  processes/actors/driver, rendezvoused through the GCS KV store; every
+  collective is a full barrier, and a two-phase-lagged garbage sweep
+  (rank 0 deletes round ``s`` keys at round ``s+2`` — by the time any
+  rank reaches ``s+2`` every rank has finished reading ``s``) keeps KV
+  memory bounded.  ``init_collective_group`` + module-level ops below.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import numpy as np
+
+from ..runtime.serialization import deserialize, serialize
+
+_NAMESPACE = "collective"
+_REDUCERS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+}
+
+
+# ---------------------------------------------------------------------------
+# device-mesh backend (NCCL analogue; XLA collectives over ICI)
+# ---------------------------------------------------------------------------
+
+class DeviceCollectiveGroup:
+    """Collectives across this host's devices as one jitted XLA program.
+
+    Input arrays carry a leading ``world_size`` axis (one slice per
+    device rank); outputs keep that axis, matching the per-rank view of
+    the reference API.
+    """
+
+    def __init__(self, devices=None):
+        import jax
+        from jax.sharding import Mesh
+        self.devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self.world_size = len(self.devices)
+        self._mesh = Mesh(np.array(self.devices), ("ranks",))
+        self._cache: dict = {}
+
+    def _sharded(self, fn, key):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map              # jax >= 0.8
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        cached = self._cache.get(key)
+        if cached is None:
+            cached = jax.jit(shard_map(
+                fn, mesh=self._mesh, in_specs=P("ranks"),
+                out_specs=P("ranks")))
+            self._cache[key] = cached
+        return cached
+
+    def allreduce(self, stacked, op: str = "sum"):
+        """(W, ...) -> (W, ...): every rank's slice becomes the
+        reduction over all ranks (lax.psum/pmax/pmin over ICI; prod has
+        no XLA primitive and lowers to all_gather + local reduce)."""
+        import jax
+        import jax.numpy as jnp
+        if op == "prod":
+            def f(x):
+                return jnp.prod(jax.lax.all_gather(x, "ranks"), axis=0)
+            return self._sharded(f, ("allreduce", op))(stacked)
+        try:
+            red = {"sum": partial(jax.lax.psum, axis_name="ranks"),
+                   "max": partial(jax.lax.pmax, axis_name="ranks"),
+                   "min": partial(jax.lax.pmin, axis_name="ranks")}[op]
+        except KeyError:
+            raise ValueError(f"unsupported allreduce op {op!r}") from None
+        return self._sharded(lambda x: red(x), ("allreduce", op))(stacked)
+
+    def allgather(self, stacked):
+        """(W, ...) -> (W, W, ...): every rank sees every slice."""
+        import jax
+
+        def f(x):
+            return jax.lax.all_gather(x[0], "ranks")[None]
+        return self._sharded(f, ("allgather",))(stacked)
+
+    def reducescatter(self, stacked, op: str = "sum"):
+        """(W, W_chunks...) -> (W, chunk): rank r holds the r-th chunk of
+        the reduction (sum rides lax.psum_scatter; max/min reduce fully
+        then keep the local rank's chunk)."""
+        import jax
+
+        if op == "sum":
+            def f(x):
+                return jax.lax.psum_scatter(
+                    x, "ranks", scatter_dimension=1, tiled=False)
+        elif op in ("max", "min"):
+            red = jax.lax.pmax if op == "max" else jax.lax.pmin
+            def f(x):
+                full = red(x, "ranks")
+                me = jax.lax.axis_index("ranks")
+                return jax.lax.dynamic_index_in_dim(
+                    full, me, axis=1, keepdims=False)
+        else:
+            raise ValueError(f"unsupported reducescatter op {op!r}")
+        return self._sharded(f, ("reducescatter", op))(stacked)
+
+    def broadcast(self, stacked, src_rank: int = 0):
+        """(W, ...) -> (W, ...): every rank gets rank ``src_rank``'s
+        slice (masked psum — compiler-friendly one-hot select)."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            me = jax.lax.axis_index("ranks")
+            contrib = jnp.where(me == src_rank, x, jnp.zeros_like(x))
+            return jax.lax.psum(contrib, "ranks")
+        return self._sharded(f, ("broadcast", src_rank))(stacked)
+
+    def ring_shift(self, stacked, shift: int = 1):
+        """(W, ...) -> (W, ...): rank r gets rank (r-shift)'s slice via
+        lax.ppermute — the send/recv ring primitive."""
+        import jax
+
+        def f(x):
+            perm = [(i, (i + shift) % self.world_size)
+                    for i in range(self.world_size)]
+            return jax.lax.ppermute(x, "ranks", perm)
+        return self._sharded(f, ("ring", shift))(stacked)
+
+
+# ---------------------------------------------------------------------------
+# process-group backend (Gloo analogue; KV rendezvous)
+# ---------------------------------------------------------------------------
+
+class _ProcessGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+        self.sid = None         # incarnation id, agreed in _handshake
+
+    # -- kv plumbing ---------------------------------------------------------
+    @staticmethod
+    def _kv(op, key, value=None):
+        from ..experimental.internal_kv import _kv
+        return _kv(op, key, value, namespace=_NAMESPACE)
+
+    def _key(self, seq: int, rank: int) -> str:
+        return f"{self.name}/{self.sid}/{seq}/{rank}"
+
+    def _handshake(self, timeout: float = 60.0) -> None:
+        """Join barrier that also derives a per-incarnation session id:
+        every rank posts a fresh random nonce, hashes all ranks' nonces
+        into a candidate ``sid``, posts it as an ack, and loops —
+        re-reading nonces — until every rank's ack carries the SAME sid.
+        Round/p2p keys live under the sid, so keys left by a PREVIOUS
+        incarnation of the same group name (crashed rank retried,
+        destroy + re-init) can never be read as this incarnation's data.
+        A rank that initially mixes a stale join nonce into its
+        candidate sees the ack mismatch and re-reads until the fresh
+        nonce lands (convergent: nonces stop changing once every member
+        has posted); joining against a generation that will never
+        re-ack — half of a dead group — raises TimeoutError instead of
+        producing a silently wrong reduction."""
+        import hashlib
+        import os
+        nonce = os.urandom(8).hex().encode()
+        self._kv("put", f"{self.name}/join/{self.rank}", nonce)
+        deadline = time.monotonic() + timeout
+        while True:
+            nonces = []
+            for r in range(self.world_size):
+                v = nonce if r == self.rank else \
+                    self._kv("get", f"{self.name}/join/{r}")
+                if v is None:
+                    break               # peer not joined yet
+                nonces.append(v)
+            if len(nonces) == self.world_size:
+                sid = hashlib.sha256(b"|".join(nonces)).hexdigest()[:12]
+                self._kv("put", f"{self.name}/ack/{self.rank}",
+                         sid.encode())
+                if all(self._kv("get", f"{self.name}/ack/{r}")
+                       == sid.encode() for r in range(self.world_size)):
+                    self.sid = sid
+                    return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {self.name}: handshake did not "
+                    f"converge within {timeout}s")
+            time.sleep(0.002)
+
+    def _post(self, seq: int, payload: bytes) -> None:
+        self._kv("put", self._key(seq, self.rank), payload)
+
+    def _collect(self, seq: int, timeout: float) -> list[bytes]:
+        """All ranks' round-``seq`` payloads (poll until complete)."""
+        deadline = time.monotonic() + timeout
+        out: list = [None] * self.world_size
+        missing = set(range(self.world_size))
+        while missing:
+            for r in list(missing):
+                v = self._kv("get", self._key(seq, r))
+                if v is not None:
+                    out[r] = v
+                    missing.discard(r)
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {self.name} round {seq}: ranks {missing} "
+                    f"missing after {timeout}s")
+            time.sleep(0.002)
+        return out
+
+    def _sweep(self) -> None:
+        """Two-phase-lagged GC: by the time this rank runs round s, every
+        rank has finished READING round s-2 (each round is a full
+        barrier), so rank 0 deletes those keys."""
+        if self.rank == 0 and self.seq >= 2:
+            for r in range(self.world_size):
+                self._kv("del", self._key(self.seq - 2, r))
+
+    def _round(self, payload: bytes, timeout: float) -> list[bytes]:
+        self._sweep()
+        seq = self.seq
+        self.seq += 1
+        self._post(seq, payload)
+        return self._collect(seq, timeout)
+
+    # -- ops -----------------------------------------------------------------
+    def allreduce(self, array, op: str = "sum", timeout: float = 60.0):
+        arrs = [deserialize(p) for p in
+                self._round(serialize(np.asarray(array)), timeout)]
+        return _REDUCERS[op](arrs)
+
+    def allgather(self, array, timeout: float = 60.0) -> list:
+        return [deserialize(p) for p in
+                self._round(serialize(np.asarray(array)), timeout)]
+
+    def reducescatter(self, array, op: str = "sum", timeout: float = 60.0):
+        """Each rank returns its chunk of the elementwise reduction
+        (arrays split on axis 0 into world_size chunks)."""
+        full = _REDUCERS[op]([deserialize(p) for p in
+                              self._round(serialize(np.asarray(array)),
+                                          timeout)])
+        return np.array_split(full, self.world_size)[self.rank]
+
+    def broadcast(self, array, src_rank: int = 0, timeout: float = 60.0):
+        payloads = self._round(
+            serialize(np.asarray(array) if array is not None else None),
+            timeout)
+        return deserialize(payloads[src_rank])
+
+    def barrier(self, timeout: float = 60.0) -> None:
+        self._round(serialize(None), timeout)
+
+    def send(self, array, dst_rank: int, timeout: float = 60.0) -> None:
+        key = f"{self.name}/{self.sid}/p2p/{self.rank}->{dst_rank}"
+        deadline = time.monotonic() + timeout
+        while self._kv("exists", key):          # previous message unread
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"send to rank {dst_rank} stalled")
+            time.sleep(0.002)
+        self._kv("put", key, serialize(np.asarray(array)))
+
+    def recv(self, src_rank: int, timeout: float = 60.0):
+        key = f"{self.name}/{self.sid}/p2p/{src_rank}->{self.rank}"
+        deadline = time.monotonic() + timeout
+        while True:
+            v = self._kv("get", key)
+            if v is not None:
+                self._kv("del", key)
+                return deserialize(v)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+            time.sleep(0.002)
+
+
+_groups: dict[str, _ProcessGroup] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join a named group from this process (driver, task, or actor).
+    Blocks until all ranks joined (reference: group handshake)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    g = _ProcessGroup(group_name, world_size, rank)
+    g._handshake()      # join barrier + per-incarnation key namespace
+    _groups[group_name] = g
+
+
+def _group(group_name: str) -> _ProcessGroup:
+    g = _groups.get(group_name)
+    if g is None:
+        raise ValueError(f"collective group {group_name!r} is not "
+                         "initialized in this process")
+    return g
+
+
+def allreduce(array, op: str = "sum", group_name: str = "default"):
+    return _group(group_name).allreduce(array, op)
+
+
+def allgather(array, group_name: str = "default") -> list:
+    return _group(group_name).allgather(array)
+
+
+def reducescatter(array, op: str = "sum", group_name: str = "default"):
+    return _group(group_name).reducescatter(array, op)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(array, src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default") -> None:
+    _group(group_name).send(array, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(src_rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Drop this process's handle on the group.  The last two rounds'
+    KV keys are deliberately NOT swept here: the lagged GC only
+    guarantees rounds <= seq-2 are fully read, so deleting newer keys
+    would race slower ranks still polling them in ``_collect`` (they
+    would time out on a collective that actually succeeded).  The
+    residue is bounded — at most 2 x world_size keys per destroyed
+    group — and dies with the session KV."""
+    _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
