@@ -1,0 +1,5 @@
+"""``python -m ray_tpu <command>`` — see ``ray_tpu/scripts/cli.py``."""
+
+from .scripts.cli import main
+
+raise SystemExit(main())

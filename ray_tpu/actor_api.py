@@ -99,11 +99,13 @@ class ActorClass:
 
     def __reduce__(self):
         # descriptor stub, mirroring RemoteFunction.__reduce__
+        # (capability-keyed: driver and client runtimes expose a
+        # registry; workers do not)
         from . import api
-        if self._cls is not None and api._runtime is not None and \
-                getattr(api._runtime, "is_driver", False):
+        registry = getattr(api._runtime, "fn_registry", None)
+        if self._cls is not None and registry is not None:
             cls_id, cls_bytes = self._materialize()
-            api._runtime.fn_registry.setdefault(cls_id, cls_bytes)
+            registry.setdefault(cls_id, cls_bytes)
         return (ActorClass, (None, None, self._cls_name, self._cls_id,
                              self._options))
 

@@ -69,10 +69,18 @@ class DriverRuntime:
         self.node_id = self.raylet.node_id
 
     # -- API ----------------------------------------------------------------
+    # The ref-based wrappers sit on *_raw methods that work on bare
+    # ObjectIDs: the head daemon serves remote clients through the raw
+    # forms so no server-side ObjectRefs are created for client-held
+    # objects — a transient counted ref here would hit zero when the
+    # handler returned and reclaim a result the client still holds
+    # (clients get the worker-frame "conservative leak" ownership).
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        return self.get_raw([r.id for r in refs], timeout)
+
+    def get_raw(self, oids, timeout: float | None = None):
         from .runtime.object_store import GetTimeoutError
         from .runtime.pull_manager import PullPriority
-        oids = [r.id for r in refs]
         # locality: remote plasma objects pull to the driver's node first
         # (reference: a driver get goes through the local plasma store +
         # PullManager at get priority)
@@ -84,6 +92,9 @@ class DriverRuntime:
         return self.store.get(oids, timeout)
 
     def put(self, value) -> ObjectRef:
+        return ObjectRef(self.put_raw(value))
+
+    def put_raw(self, value):
         with self._put_lock:
             self._put_index += 1
             idx = self._put_index
@@ -97,14 +108,17 @@ class DriverRuntime:
             self.cluster.seal_serialized(oid, data, self.raylet.row)
         else:
             self.store.put(oid, value)
-        return ObjectRef(oid)
+        return oid
 
     def wait(self, refs, num_returns, timeout):
-        ready_ids, not_ready_ids = self.store.wait(
+        ready_ids, not_ready_ids = self.wait_raw(
             [r.id for r in refs], num_returns, timeout)
         by_id = {r.id: r for r in refs}
         return ([by_id[i] for i in ready_ids],
                 [by_id[i] for i in not_ready_ids])
+
+    def wait_raw(self, oids, num_returns, timeout):
+        return self.store.wait(oids, num_returns, timeout)
 
     def submit_spec(self, spec: TaskSpec, fn_id: str,
                     fn_bytes: bytes | None) -> None:
@@ -204,13 +218,16 @@ class RemoteFunction:
         # reaches a worker only as a task argument still resolves; the
         # reentrancy guard skips this while serializing a recursive fn's
         # own body (that submission registers it anyway).
+        registry = getattr(_runtime, "fn_registry", None)
         if not getattr(self, "_reducing", False) and self._fn is not None \
-                and _runtime is not None and getattr(_runtime, "is_driver",
-                                                    False):
+                and registry is not None:
+            # capability-keyed, not is_driver: client mode exposes an
+            # RPC-backed registry so stubs shipped as ARGS resolve on
+            # the cluster too; workers have no registry attr and skip
             self._reducing = True
             try:
                 fn_id, fn_bytes = self._materialize()
-                _runtime.fn_registry.setdefault(fn_id, fn_bytes)
+                registry.setdefault(fn_id, fn_bytes)
             finally:
                 self._reducing = False
         return (RemoteFunction,
@@ -336,15 +353,39 @@ def init(resources: dict[str, float] | None = None,
          num_workers: int | None = None,
          system_config: dict | None = None,
          runtime_env: dict | None = None,
+         address: str | None = None,
          cluster=None) -> None:
     """Start the runtime.  ``cluster=`` adopts an existing simulated
     multi-node ``cluster_utils.Cluster`` (the reference's
     ``ray.init(address=cluster.address)`` pattern); ``runtime_env=`` is
-    the job-level default environment for every task."""
+    the job-level default environment for every task; ``address=`` (or
+    ``"auto"`` with ``RAY_TPU_ADDRESS`` set) attaches to a running head
+    daemon as a CLIENT instead of starting a local cluster (reference:
+    ``ray.init("ray://…")``)."""
     global _runtime
     with _lock:
         if _runtime is not None:
             raise RuntimeError("ray_tpu already initialized")
+        if address == "auto":
+            address = os.environ.get("RAY_TPU_ADDRESS")
+            if not address:
+                raise RuntimeError(
+                    "init(address='auto') but RAY_TPU_ADDRESS is unset "
+                    "and no head daemon address was given")
+        if address is not None:
+            conflicting = {"resources": resources,
+                           "num_workers": num_workers,
+                           "system_config": system_config,
+                           "cluster": cluster}
+            bad = [k for k, v in conflicting.items() if v is not None]
+            if bad:
+                raise ValueError(
+                    f"init(address=...) attaches to an existing cluster; "
+                    f"{bad} configure a LOCAL cluster and would be "
+                    "silently ignored — drop them or drop address")
+            from .util.client import ClientRuntime
+            _runtime = ClientRuntime(address, runtime_env=runtime_env)
+            return
         if system_config is not None:
             Config.reset(system_config)
         cfg = get_config()
@@ -369,8 +410,11 @@ def is_initialized() -> bool:
 def shutdown() -> None:
     global _runtime
     with _lock:
-        if _runtime is not None and getattr(_runtime, "is_driver", False):
-            _runtime.shutdown()
+        if _runtime is not None:
+            if getattr(_runtime, "is_driver", False):
+                _runtime.shutdown()
+            elif hasattr(_runtime, "close"):
+                _runtime.close()        # client mode: drop the connection
         _runtime = None
 
 
@@ -403,6 +447,8 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     rt = _get_runtime()
     if rt.is_driver:
         rt.raylet.cancel(ref.task_id(), force=force)
+    elif hasattr(rt, "cancel_task"):    # client mode
+        rt.cancel_task(ref.task_id(), force=force)
 
 
 def kill(actor_handle, *, no_restart: bool = True) -> None:
@@ -434,6 +480,8 @@ def get_actor(name: str):
 
 def available_resources() -> dict[str, float]:
     rt = _get_runtime()
+    if not hasattr(rt, "crm"):          # client mode: ask the head
+        return rt.available_resources()
     totals, avail, mask = rt.crm.arrays()
     out: dict[str, float] = {}
     for row in range(totals.shape[0]):
@@ -449,6 +497,8 @@ def available_resources() -> dict[str, float]:
 
 def cluster_resources() -> dict[str, float]:
     rt = _get_runtime()
+    if not hasattr(rt, "crm"):          # client mode: ask the head
+        return rt.cluster_resources()
     totals, _, mask = rt.crm.arrays()
     out: dict[str, float] = {}
     for row in range(totals.shape[0]):
@@ -467,6 +517,14 @@ def timeline(filename: str | None = None):
     ``ray.timeline``).  Returns the event list, or writes it to
     ``filename`` and returns the path."""
     rt = _get_runtime()
+    if not hasattr(rt, "cluster"):      # client mode: ask the head
+        events = rt.timeline()
+        if filename is not None:
+            import json
+            with open(filename, "w") as f:
+                json.dump(events, f)
+            return filename
+        return events
     events = rt.cluster.events
     if filename is not None:
         return events.dump_timeline(filename)
@@ -475,6 +533,8 @@ def timeline(filename: str | None = None):
 
 def nodes() -> list[dict]:
     rt = _get_runtime()
+    if not hasattr(rt, "crm"):          # client mode: ask the head
+        return rt.nodes()
     out = []
     totals, _, mask = rt.crm.arrays()
     for row in range(totals.shape[0]):

@@ -1,0 +1,92 @@
+"""Pipelining RPC client (the retryable-gRPC-client analogue).
+
+A single connection multiplexes concurrent calls: each call gets a
+request id and parks on an event; one reader thread dispatches replies
+by id.  Server-side exceptions re-raise here with the remote traceback
+attached (SURVEY.md §1 layer 2).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+from .wire import recv_frame, send_frame
+
+
+class RpcConnectionError(ConnectionError):
+    """The peer is gone (daemon stopped, network failure)."""
+
+
+class RemoteRpcError(RuntimeError):
+    """A handler raised on the server; carries the remote traceback."""
+
+    def __init__(self, exc_type: str, message: str, remote_tb: str):
+        super().__init__(f"{exc_type}: {message}")
+        self.exc_type = exc_type
+        self.remote_tb = remote_tb
+
+
+class RpcClient:
+    def __init__(self, address: str, timeout: float = 10.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)     # calls manage their own deadlines
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._pending: dict[int, list] = {}    # id -> [event, ok, payload]
+        self._ids = itertools.count()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True, name="rpc-reader")
+        self._reader.start()
+
+    def call(self, method: str, *args, timeout: float | None = None,
+             **kwargs):
+        req_id = next(self._ids)
+        slot = [threading.Event(), None, None]
+        self._pending[req_id] = slot
+        try:
+            with self._wlock:
+                if self._closed:
+                    raise RpcConnectionError("client is closed")
+                send_frame(self._sock, (req_id, method, args, kwargs))
+        except (OSError, ConnectionError) as e:
+            self._pending.pop(req_id, None)
+            raise RpcConnectionError(str(e)) from e
+        if not slot[0].wait(timeout):
+            self._pending.pop(req_id, None)
+            raise TimeoutError(
+                f"rpc {method} timed out after {timeout}s")
+        if self._closed and slot[1] is None:
+            raise RpcConnectionError("connection lost awaiting reply")
+        if slot[1]:
+            return slot[2]
+        raise RemoteRpcError(*slot[2])
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                frame = recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                frame = None
+            if frame is None:
+                break
+            req_id, ok, payload = frame
+            slot = self._pending.pop(req_id, None)
+            if slot is not None:
+                slot[1], slot[2] = ok, payload
+                slot[0].set()
+        self._closed = True
+        # wake every waiter; they observe _closed and raise
+        for slot in list(self._pending.values()):
+            slot[0].set()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,112 @@
+"""Threaded TCP RPC server (the GrpcServer analogue).
+
+One reader thread per connection; each REQUEST runs on its own worker
+thread so a long/blocking handler (``ray.get``) never stalls the other
+requests pipelined on the same connection — the same property gRPC's
+completion queues give the reference (SURVEY.md §1 layer 2).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import traceback
+
+from .wire import recv_frame, send_frame
+
+
+class RpcServer:
+    def __init__(self, handlers: dict, host: str = "127.0.0.1",
+                 port: int = 0):
+        """``handlers``: method name -> callable(*args, **kwargs).
+        ``port=0`` picks a free port (read it from ``self.address``)."""
+        self._handlers = dict(handlers)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stopped = False
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rpc-accept")
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "RpcServer":
+        self._accept_thread.start()
+        return self
+
+    def add_handler(self, name: str, fn) -> None:
+        self._handlers[name] = fn
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return          # socket closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="rpc-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        # replies from concurrent handler threads interleave on one
+        # socket: serialize the WRITES, never the handlers
+        wlock = threading.Lock()
+        try:
+            while True:
+                try:
+                    frame = recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                if frame is None:
+                    return
+                req_id, method, args, kwargs = frame
+                threading.Thread(
+                    target=self._run_handler,
+                    args=(conn, wlock, req_id, method, args, kwargs),
+                    daemon=True, name=f"rpc-h-{method}").start()
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _run_handler(self, conn, wlock, req_id, method, args,
+                     kwargs) -> None:
+        try:
+            fn = self._handlers.get(method)
+            if fn is None:
+                raise AttributeError(f"no rpc method {method!r}")
+            result = fn(*args, **kwargs)
+            ok, payload = True, result
+        except BaseException as e:     # noqa: BLE001 — typed error reply
+            ok, payload = False, (type(e).__name__, str(e),
+                                  traceback.format_exc())
+        try:
+            with wlock:
+                send_frame(conn, (req_id, ok, payload))
+        except (OSError, ConnectionError):
+            pass                # client went away; nothing to tell it
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

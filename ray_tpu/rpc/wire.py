@@ -1,0 +1,50 @@
+"""Frame codec shared by the RPC server and client.
+
+Frames are ``4-byte big-endian length + cloudpickle payload`` over a
+stream socket.  Requests are ``(req_id, method, args, kwargs)``; replies
+are ``(req_id, ok: bool, payload)`` where a non-ok payload is
+``(exc_type_name, message, traceback_str)``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from ..runtime.serialization import deserialize, serialize
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 512 * 1024 * 1024       # sanity bound, not a protocol limit
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    data = serialize(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket):
+    """One frame, or None on clean EOF."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame of {n} bytes exceeds sanity bound")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("connection closed mid-frame")
+    return deserialize(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    """``n`` bytes, None on clean EOF; a drop mid-read is an error —
+    silently treating a truncated header as EOF would swallow a frame."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise ConnectionError("connection closed mid-frame")
+            return None
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,210 @@
+"""Head daemon: a long-lived process hosting the cluster + control RPC.
+
+Reference parity: the head node runs ``gcs_server`` + raylet + dashboard,
+and remote drivers attach via ``ray.init("ray://…")`` (the ray client
+proxy, ``python/ray/util/client/server``) while ``ray job submit`` runs
+entrypoints through the dashboard's job module (SURVEY.md §1 layers 2/15,
+§3.1; mount empty).
+
+In this rebuild the daemon owns one ``DriverRuntime`` (cluster, raylets,
+TPU scheduling data plane) and serves two client surfaces over
+``ray_tpu.rpc``:
+
+- **client mode** — the full task/actor/object API proxied for remote
+  ``init(address=…)`` drivers.  Client-held objects deliberately take the
+  worker-frame ownership model: the daemon never creates counted
+  ObjectRefs for them (a transient server-side ref would hit zero when
+  the handler returned and reclaim a result the client still holds).
+- **operations** — status/memory/timeline introspection and job
+  submission (``JobManager``), consumed by the CLI.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..common.ids import ActorID, JobID, ObjectID, TaskID
+from .serialization import deserialize, serialize
+
+
+class HeadNode:
+    def __init__(self, resources: dict | None = None,
+                 num_workers: int | None = None,
+                 system_config: dict | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        from .. import api
+        from ..rpc import RpcServer
+        from .job_manager import JobManager
+        api.init(resources=resources, num_workers=num_workers,
+                 system_config=system_config)
+        self._rt = api._get_runtime()
+        self._lock = threading.Lock()
+        self.jobs = JobManager(self._rt.cluster.session_dir)
+        self.server = RpcServer(self._handlers(), host=host, port=port)
+        self.server.start()
+        self.jobs.head_address = self.server.address
+        self._stop_event = threading.Event()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def wait_for_shutdown(self, timeout: float | None = None) -> bool:
+        return self._stop_event.wait(timeout)
+
+    def stop(self) -> None:
+        self.jobs.stop_all()
+        self.server.stop()
+        from .. import api
+        api.shutdown()
+        self._stop_event.set()
+
+    # -- handler table -------------------------------------------------------
+    def _handlers(self) -> dict:
+        return {
+            "ping": self._ping,
+            "connect": self._connect,
+            "fn_register": self._fn_register,
+            "submit_spec": self._submit_spec,
+            "get": self._get,
+            "put": self._put,
+            "wait": self._wait,
+            "create_actor": self._create_actor,
+            "submit_actor_call": self._submit_actor_call,
+            "kill_actor": self._kill_actor,
+            "get_actor_by_name": self._get_actor_by_name,
+            "cancel": self._cancel,
+            "kv": self._kv,
+            "status": self._status,
+            "nodes": self._nodes,
+            "available_resources": self._available_resources,
+            "cluster_resources": self._cluster_resources,
+            "timeline": self._timeline,
+            "memory": self._memory,
+            "job_submit": self.jobs.submit,
+            "job_status": self.jobs.status,
+            "job_list": self.jobs.list,
+            "job_logs": self.jobs.logs,
+            "job_stop": self.jobs.stop,
+            "stop_daemon": self._stop_async,
+        }
+
+    # -- client-mode surface -------------------------------------------------
+    def _ping(self) -> dict:
+        return {"ok": True, "session_dir": self._rt.cluster.session_dir}
+
+    def _connect(self, job_runtime_env: dict | None) -> dict:
+        """A client attaches: allocate it a job id; a job-level env from
+        the FIRST env-bearing client becomes the cluster default (one
+        shared job env — the in-process simplification)."""
+        job_id = JobID.next()
+        with self._lock:    # check-then-set: FIRST env-bearing client
+            if job_runtime_env and not self._rt.cluster.job_runtime_env:
+                self._rt.cluster.job_runtime_env = job_runtime_env
+        return {"job_id": job_id.binary(),
+                "session_dir": self._rt.cluster.session_dir}
+
+    def _fn_register(self, fn_id: str, fn_bytes: bytes) -> None:
+        self._rt.fn_registry.setdefault(fn_id, fn_bytes)
+
+    def _submit_spec(self, spec_bytes: bytes, fn_id: str,
+                     fn_bytes: bytes | None) -> None:
+        from .object_ref import counter_suppressed
+        # suppressed: counted server-side twins of the client's refs
+        # would decref to zero on lineage eviction and reclaim objects
+        # the client still holds (see counter_suppressed docstring)
+        with counter_suppressed():
+            spec = deserialize(spec_bytes)
+        self._rt.submit_spec(spec, fn_id, fn_bytes)
+
+    def _get(self, oid_bins: list[bytes], timeout: float | None):
+        oids = [ObjectID(b) for b in oid_bins]
+        try:
+            return ("ok", serialize(self._rt.get_raw(oids, timeout)))
+        except BaseException as e:      # noqa: BLE001 — typed re-raise
+            return ("exc", serialize(e))    # client-side
+
+    def _put(self, value_bytes: bytes) -> bytes:
+        return self._rt.put_raw(deserialize(value_bytes)).binary()
+
+    def _wait(self, oid_bins: list[bytes], num_returns: int,
+              timeout: float | None):
+        ready, not_ready = self._rt.wait_raw(
+            [ObjectID(b) for b in oid_bins], num_returns, timeout)
+        return ([o.binary() for o in ready],
+                [o.binary() for o in not_ready])
+
+    def _create_actor(self, actor_bin: bytes, cls_id: str,
+                      cls_bytes: bytes | None, payload: bytes) -> None:
+        from .object_ref import counter_suppressed
+        with counter_suppressed():      # see _submit_spec
+            (args, kwargs, max_restarts, max_task_retries, name, res,
+             strategy, runtime_env) = deserialize(payload)
+        self._rt.create_actor(ActorID(actor_bin), cls_id, cls_bytes,
+                              args, kwargs, max_restarts,
+                              max_task_retries, name, resources=res,
+                              strategy=strategy, runtime_env=runtime_env)
+
+    def _submit_actor_call(self, actor_bin: bytes, task_bin: bytes,
+                           method: str, payload: bytes,
+                           num_returns: int) -> None:
+        from .object_ref import counter_suppressed
+        with counter_suppressed():      # see _submit_spec
+            args, kwargs = deserialize(payload)
+        self._rt.actor_manager.submit(
+            ActorID(actor_bin), TaskID(task_bin), method, args, kwargs,
+            num_returns)
+
+    def _kill_actor(self, actor_bin: bytes, no_restart: bool) -> None:
+        self._rt.actor_manager.kill(ActorID(actor_bin),
+                                    no_restart=no_restart)
+
+    def _get_actor_by_name(self, name: str) -> bytes | None:
+        aid = self._rt.actor_manager.get_by_name(name)
+        return aid.binary() if aid is not None else None
+
+    def _cancel(self, task_bin: bytes, force: bool) -> None:
+        self._rt.raylet.cancel(TaskID(task_bin), force=force)
+
+    def _kv(self, op: str, key: bytes, value: bytes | None,
+            namespace: str, overwrite: bool):
+        return self._rt.cluster.kv.dispatch(op, key, value, namespace,
+                                            overwrite)
+
+    # -- operations surface --------------------------------------------------
+    def _status(self) -> dict:
+        from .. import api
+        cluster = self._rt.cluster
+        return {
+            "address": self.address,
+            "session_dir": cluster.session_dir,
+            "nodes": api.nodes(),
+            "available_resources": api.available_resources(),
+            "cluster_resources": api.cluster_resources(),
+            "store": cluster.store.stats(),
+            "jobs": self.jobs.list(),
+        }
+
+    def _nodes(self) -> list[dict]:
+        from .. import api
+        return api.nodes()
+
+    def _available_resources(self) -> dict:
+        from .. import api
+        return api.available_resources()
+
+    def _cluster_resources(self) -> dict:
+        from .. import api
+        return api.cluster_resources()
+
+    def _timeline(self) -> list[dict]:
+        return self._rt.cluster.events.timeline()
+
+    def _memory(self) -> dict:
+        return self._rt.cluster.store.stats()
+
+    def _stop_async(self) -> str:
+        # reply first, THEN tear down — stopping inline would close the
+        # socket under the caller's pending reply
+        threading.Timer(0.2, self.stop).start()
+        return "stopping"

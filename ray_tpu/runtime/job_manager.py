@@ -1,0 +1,156 @@
+"""Job submission: run driver entrypoints against the head daemon.
+
+Reference parity: ``ray job submit`` — the dashboard's job module
+(``python/ray/dashboard/modules/job/``) runs the entrypoint command as a
+subprocess on the head node with ``RAY_ADDRESS`` exported, captures its
+output to per-job logs under the session dir, and tracks
+PENDING/RUNNING/SUCCEEDED/FAILED/STOPPED status with metadata
+(SURVEY.md §1 layer 15; mount empty).
+
+Here each job runs with ``RAY_TPU_ADDRESS`` pointing back at this
+daemon, so an entrypoint that calls ``ray_tpu.init(address="auto")``
+attaches to the shared cluster in client mode.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import threading
+import time
+
+
+class JobInfo:
+    __slots__ = ("job_id", "entrypoint", "status", "metadata",
+                 "start_time", "end_time", "log_path", "proc",
+                 "return_code")
+
+    def __init__(self, job_id: str, entrypoint: str, metadata: dict,
+                 log_path: str):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.status = "PENDING"
+        self.metadata = metadata
+        self.start_time = time.time()
+        self.end_time: float | None = None
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+        self.return_code: int | None = None
+
+    def to_dict(self) -> dict:
+        return {"job_id": self.job_id, "entrypoint": self.entrypoint,
+                "status": self.status, "metadata": self.metadata,
+                "start_time": self.start_time, "end_time": self.end_time,
+                "return_code": self.return_code}
+
+
+class JobManager:
+    def __init__(self, session_dir: str):
+        self._log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._jobs: dict[str, JobInfo] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+        self.head_address: str | None = None    # set by HeadNode
+
+    def submit(self, entrypoint: str, runtime_env: dict | None = None,
+               metadata: dict | None = None) -> str:
+        cmd = shlex.split(entrypoint)
+        if not cmd:
+            raise ValueError("empty job entrypoint")
+        with self._lock:
+            self._counter += 1
+            job_id = f"raysubmit_{self._counter:06d}_{os.urandom(4).hex()}"
+        log_path = os.path.join(self._log_dir, f"job-{job_id}.log")
+        info = JobInfo(job_id, entrypoint, metadata or {}, log_path)
+        with self._lock:
+            self._jobs[job_id] = info
+        env = dict(os.environ)
+        env["RAY_TPU_JOB_ID"] = job_id
+        if self.head_address:
+            env["RAY_TPU_ADDRESS"] = self.head_address
+        # the entrypoint must resolve the SAME ray_tpu package this
+        # daemon runs, wherever its cwd is (jobs inherit the cluster's
+        # environment in the reference)
+        import ray_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        parts = [pkg_root] + [p for p in
+                              env.get("PYTHONPATH", "").split(os.pathsep)
+                              if p]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update(runtime_env["env_vars"])
+        cwd = (runtime_env or {}).get("working_dir") or os.getcwd()
+        log_f = open(log_path, "wb")
+        try:
+            info.proc = subprocess.Popen(
+                cmd, stdout=log_f, stderr=log_f, env=env, cwd=cwd)
+        except (OSError, ValueError) as e:
+            log_f.write(f"failed to start: {e}\n".encode())
+            log_f.close()
+            info.status = "FAILED"
+            info.end_time = time.time()
+            return job_id
+        info.status = "RUNNING"
+        threading.Thread(target=self._reap, args=(info, log_f),
+                         daemon=True, name=f"job-{job_id}").start()
+        return job_id
+
+    def _reap(self, info: JobInfo, log_f) -> None:
+        rc = info.proc.wait()
+        log_f.close()
+        with self._lock:
+            info.return_code = rc
+            info.end_time = time.time()
+            if info.status != "STOPPED":
+                info.status = "SUCCEEDED" if rc == 0 else "FAILED"
+
+    def status(self, job_id: str) -> dict:
+        info = self._jobs.get(job_id)
+        if info is None:
+            raise KeyError(f"no job {job_id!r}")
+        return info.to_dict()
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [j.to_dict() for j in self._jobs.values()]
+
+    def logs(self, job_id: str) -> str:
+        info = self._jobs.get(job_id)
+        if info is None:
+            raise KeyError(f"no job {job_id!r}")
+        try:
+            with open(info.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop(self, job_id: str) -> bool:
+        info = self._jobs.get(job_id)
+        if info is None:
+            raise KeyError(f"no job {job_id!r}")
+        if info.proc is not None and info.proc.poll() is None:
+            info.status = "STOPPED"
+            info.proc.terminate()
+            return True
+        return False
+
+    def stop_all(self) -> None:
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for j in jobs:
+            if j.proc is not None and j.proc.poll() is None:
+                j.status = "STOPPED"
+                j.proc.terminate()
+
+    def wait(self, job_id: str, timeout: float = 120.0) -> dict:
+        """Block until the job leaves PENDING/RUNNING (test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.status(job_id)
+            if st["status"] not in ("PENDING", "RUNNING"):
+                return st
+            time.sleep(0.05)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

@@ -15,9 +15,13 @@ unbalanced decref.
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 from ..common.ids import ObjectID
 
 _counter = None     # the owner-process ReferenceCounter, or None
+_suppress = threading.local()   # per-thread: refs built uncounted
 
 
 def install_counter(counter) -> None:
@@ -32,12 +36,30 @@ def uninstall_counter(counter) -> None:
         _counter = None
 
 
+@contextlib.contextmanager
+def counter_suppressed():
+    """ObjectRefs built on THIS thread inside the block are uncounted.
+
+    The head daemon deserializes client-submitted specs/actor args under
+    this: the client's own refs are outside the owner counter, so a
+    counted server-side twin would eventually decref to zero (lineage
+    eviction, actor death) and reclaim objects the client still holds —
+    client-held objects take the worker-frame conservative-leak
+    ownership instead."""
+    prev = getattr(_suppress, "on", False)
+    _suppress.on = True
+    try:
+        yield
+    finally:
+        _suppress.on = prev
+
+
 class ObjectRef:
     __slots__ = ("_id", "_ct")
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
-        ct = _counter
+        ct = None if getattr(_suppress, "on", False) else _counter
         self._ct = ct
         if ct is not None:
             ct.incref(object_id)

@@ -1,0 +1,342 @@
+"""The ``ray_tpu`` command line.
+
+Reference parity: ``ray start --head`` boots the head daemon (GCS +
+raylet), ``ray stop`` tears it down, ``ray status/memory/timeline``
+introspect, ``ray job submit -- <cmd>`` runs entrypoints on the cluster,
+``ray microbenchmark`` is the single-node perf suite from
+``python/ray/_private/ray_perf.py`` (BASELINE config #1) — SURVEY.md
+§1 layer 15, §4; mount empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+# NOTE the dash: a directory literally named ray_tpu on a script's
+# sys.path[0] (e.g. /tmp) would shadow the real package as an empty
+# namespace package
+STATE_DIR = "/tmp/ray_tpu-state"
+ADDRESS_FILE = f"{STATE_DIR}/ray_current_cluster"
+
+
+def _write_address(address: str) -> None:
+    os.makedirs(os.path.dirname(ADDRESS_FILE), exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(address)
+
+
+def _resolve_address(explicit: str | None) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise SystemExit(
+            "no running cluster found: pass --address, set "
+            "RAY_TPU_ADDRESS, or run `ray_tpu start --head` first")
+
+
+def _client(address: str | None):
+    from ..rpc import RpcClient
+    return RpcClient(_resolve_address(address))
+
+
+# -- subcommands -------------------------------------------------------------
+
+def cmd_head(args) -> int:
+    """Foreground daemon (what ``start --head`` detaches)."""
+    from ..runtime.head import HeadNode
+    resources = json.loads(args.resources) if args.resources else None
+    head = HeadNode(resources=resources, num_workers=args.num_workers,
+                    port=args.port)
+    _write_address(head.address)
+    print(f"ray_tpu head listening on {head.address}", flush=True)
+    try:
+        head.wait_for_shutdown()
+    except KeyboardInterrupt:
+        head.stop()
+    return 0
+
+
+def cmd_start(args) -> int:
+    if not args.head:
+        raise SystemExit("only --head is supported (worker nodes join "
+                         "in-process via cluster_utils.Cluster)")
+    if args.block:
+        return cmd_head(args)
+    os.makedirs(STATE_DIR, exist_ok=True)
+    log_path = os.path.join(STATE_DIR, "head.log")
+    cmd = [sys.executable, "-m", "ray_tpu", "head",
+           "--port", str(args.port)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    if args.num_workers is not None:
+        cmd += ["--num-workers", str(args.num_workers)]
+    spawn_t = time.time()
+    with open(log_path, "ab") as log_f:
+        proc = subprocess.Popen(cmd, stdout=log_f, stderr=log_f,
+                                start_new_session=True)
+    # the daemon writes the address file once its RPC server is up;
+    # only a file written AFTER the spawn counts — a stale file from a
+    # crashed daemon would hand out a dead address
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if os.path.exists(ADDRESS_FILE) and \
+                os.path.getmtime(ADDRESS_FILE) >= spawn_t - 1.0:
+            with open(ADDRESS_FILE) as f:
+                addr = f.read().strip()
+            print(f"started head daemon (pid {proc.pid}) at {addr}")
+            print(f"logs: {log_path}")
+            print(f'attach with: ray_tpu.init(address="{addr}")')
+            return 0
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"head daemon exited rc={proc.returncode}; see {log_path}")
+        time.sleep(0.1)
+    raise SystemExit("head daemon did not come up within 30s")
+
+
+def cmd_stop(args) -> int:
+    try:
+        resolved = _resolve_address(args.address)
+    except SystemExit:
+        print("no running cluster")
+        return 0
+    from ..rpc import RpcClient
+    client = RpcClient(resolved)
+    try:
+        client.call("stop_daemon", timeout=10.0)
+        print("cluster stopping")
+    finally:
+        client.close()
+        # only clear the address file if it records THE cluster we just
+        # stopped — `stop --address other:port` must not orphan a
+        # still-running local daemon's record
+        try:
+            with open(ADDRESS_FILE) as f:
+                recorded = f.read().strip()
+            if recorded == resolved:
+                os.unlink(ADDRESS_FILE)
+        except FileNotFoundError:
+            pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    client = _client(args.address)
+    try:
+        st = client.call("status", timeout=30.0)
+    finally:
+        client.close()
+    print(f"address: {st['address']}")
+    print(f"session: {st['session_dir']}")
+    print(f"nodes ({len(st['nodes'])}):")
+    for n in st["nodes"]:
+        print(f"  {n['NodeID'][:16]}…  row={n['Row']} "
+              f"labels={n['Labels']}")
+    print("resources:")
+    total, avail = st["cluster_resources"], st["available_resources"]
+    for name in sorted(total):
+        print(f"  {avail.get(name, 0.0):.1f}/{total[name]:.1f} {name}")
+    if st["jobs"]:
+        print(f"jobs ({len(st['jobs'])}):")
+        for j in st["jobs"]:
+            print(f"  {j['job_id']}  {j['status']:<10} {j['entrypoint']}")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    client = _client(args.address)
+    try:
+        stats = client.call("memory", timeout=30.0)
+    finally:
+        client.close()
+    for k, v in sorted(stats.items()):
+        print(f"{k}: {v}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    client = _client(args.address)
+    try:
+        events = client.call("timeline", timeout=30.0)
+    finally:
+        client.close()
+    out = args.output or f"timeline-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {out}")
+    return 0
+
+
+def cmd_job(args) -> int:
+    client = _client(args.address)
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+            # shlex.join, not " ".join: args with spaces/quotes (-c
+            # "print('x')") must survive the server-side shlex.split
+            entrypoint = shlex.join(args.entrypoint)
+            runtime_env = json.loads(args.runtime_env_json) \
+                if args.runtime_env_json else None
+            job_id = client.call("job_submit", entrypoint, runtime_env,
+                                 {"submitter": "cli"}, timeout=30.0)
+            print(job_id)
+            if args.wait:
+                while True:
+                    st = client.call("job_status", job_id, timeout=30.0)
+                    if st["status"] not in ("PENDING", "RUNNING"):
+                        print(st["status"])
+                        print(client.call("job_logs", job_id,
+                                          timeout=30.0), end="")
+                        return 0 if st["status"] == "SUCCEEDED" else 1
+                    time.sleep(0.25)
+        elif args.job_cmd == "status":
+            print(json.dumps(client.call("job_status", args.job_id,
+                                         timeout=30.0), indent=2))
+        elif args.job_cmd == "logs":
+            print(client.call("job_logs", args.job_id, timeout=30.0),
+                  end="")
+        elif args.job_cmd == "list":
+            for j in client.call("job_list", timeout=30.0):
+                print(f"{j['job_id']}  {j['status']:<10} "
+                      f"{j['entrypoint']}")
+        elif args.job_cmd == "stop":
+            stopped = client.call("job_stop", args.job_id, timeout=30.0)
+            print("stopped" if stopped else "already finished")
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    """Single-node perf suite (reference ``ray microbenchmark``,
+    BASELINE config #1: many tiny tasks)."""
+    import ray_tpu
+
+    ray_tpu.init()
+    results = {}
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        # warmup
+        ray_tpu.get([noop.remote() for _ in range(50)], timeout=60)
+
+        n = args.num_tasks
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+        dt = time.perf_counter() - t0
+        results["tasks_per_second"] = n / dt
+
+        actor = Counter.remote()
+        ray_tpu.get(actor.inc.remote(), timeout=60)
+        m = max(n // 4, 100)
+        t0 = time.perf_counter()
+        ray_tpu.get([actor.inc.remote() for _ in range(m)], timeout=300)
+        dt = time.perf_counter() - t0
+        results["actor_calls_per_second"] = m / dt
+
+        t0 = time.perf_counter()
+        for _ in range(100):
+            ray_tpu.get(ray_tpu.put(b"x" * 1024), timeout=60)
+        results["put_get_p50_us"] = (time.perf_counter() - t0) / 100 * 1e6
+    finally:
+        ray_tpu.shutdown()
+    for k, v in results.items():
+        print(f"{k}: {v:,.1f}")
+    print(json.dumps({"microbenchmark": results}))
+    return 0
+
+
+# -- parser ------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ph = sub.add_parser("head", help="run the head daemon in foreground")
+    ph.add_argument("--port", type=int, default=0)
+    ph.add_argument("--resources", default=None)
+    ph.add_argument("--num-workers", type=int, default=None)
+    ph.set_defaults(fn=cmd_head)
+
+    ps = sub.add_parser("start", help="start cluster daemons")
+    ps.add_argument("--head", action="store_true")
+    ps.add_argument("--port", type=int, default=0)
+    ps.add_argument("--resources", default=None,
+                    help='JSON, e.g. \'{"CPU": 8, "memory": 16}\'')
+    ps.add_argument("--num-workers", type=int, default=None)
+    ps.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    ps.set_defaults(fn=cmd_start)
+
+    pst = sub.add_parser("stop", help="stop the running cluster")
+    pst.add_argument("--address", default=None)
+    pst.set_defaults(fn=cmd_stop)
+
+    pq = sub.add_parser("status", help="cluster status")
+    pq.add_argument("--address", default=None)
+    pq.set_defaults(fn=cmd_status)
+
+    pm = sub.add_parser("memory", help="object store stats")
+    pm.add_argument("--address", default=None)
+    pm.set_defaults(fn=cmd_memory)
+
+    pt = sub.add_parser("timeline", help="dump Chrome trace events")
+    pt.add_argument("--address", default=None)
+    pt.add_argument("-o", "--output", default=None)
+    pt.set_defaults(fn=cmd_timeline)
+
+    pj = sub.add_parser("job", help="job submission")
+    pj.add_argument("--address", default=None)
+    jsub = pj.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--runtime-env-json", default=None)
+    js.add_argument("--wait", action="store_true",
+                    help="block until the job finishes; exit 1 on failure")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with --)")
+    for name in ("status", "logs", "stop"):
+        jx = jsub.add_parser(name)
+        jx.add_argument("job_id")
+    jsub.add_parser("list")
+    pj.set_defaults(fn=cmd_job)
+
+    pb = sub.add_parser("microbenchmark",
+                        help="single-node perf suite")
+    pb.add_argument("--num-tasks", type=int, default=2000)
+    pb.set_defaults(fn=cmd_microbenchmark)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "entrypoint", None) and args.entrypoint \
+            and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,138 @@
+"""Client mode: attach a driver to a running head daemon over RPC.
+
+Reference parity: ``ray.init("ray://host:port")`` — the ray client
+(``python/ray/util/client/``) proxies the full task/actor/object API
+through a gRPC server colocated with the cluster (SURVEY.md §2.2; mount
+empty).  Here the proxy speaks ``ray_tpu.rpc`` to ``runtime/head.py``.
+
+The ClientRuntime presents the WORKER-context surface (``is_driver``
+False): ``RemoteFunction.remote``/``ActorClass.remote`` take their
+non-driver path, deriving task ids from a synthetic driver task id under
+the server-assigned job id.  Objects the client holds are never counted
+on the server (worker-frame "conservative leak" ownership — see
+``runtime/head.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..common.ids import ActorID, ObjectID, TaskID
+from ..runtime.object_ref import ObjectRef
+from ..runtime.serialization import deserialize, serialize
+
+
+class _RemoteFnRegistry:
+    """Dict-shaped shim over the head's function table: eager stub
+    registration in ``RemoteFunction.__reduce__`` works unchanged."""
+
+    def __init__(self, client: "ClientRuntime"):
+        self._client = client
+        self._known: set[str] = set()   # avoid re-shipping bytes
+
+    def setdefault(self, fn_id: str, fn_bytes: bytes | None):
+        if fn_bytes is not None and fn_id not in self._known:
+            self._client._call("fn_register", fn_id, fn_bytes)
+            self._known.add(fn_id)
+        return fn_bytes
+
+    def __contains__(self, fn_id: str) -> bool:
+        return fn_id in self._known
+
+
+class ClientRuntime:
+    is_driver = False
+
+    def __init__(self, address: str, runtime_env: dict | None = None):
+        from ..rpc import RpcClient
+        self.address = address
+        self._rpc = RpcClient(address)
+        self._lock = threading.Lock()
+        info = self._call("connect", runtime_env)
+        from ..common.ids import JobID
+        self.job_id = JobID(info["job_id"])
+        self.session_dir = info["session_dir"]
+        # non-driver submission paths derive ids from current_task_id
+        self.current_task_id = TaskID.for_task(self.job_id)
+        self.fn_registry = _RemoteFnRegistry(self)
+
+    def _call(self, method: str, *args, **kwargs):
+        return self._rpc.call(method, *args, **kwargs)
+
+    # -- core API (the surface api.py/actor_api.py dispatch to) --------------
+    def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None) -> None:
+        self._call("submit_spec", serialize(spec), fn_id, fn_bytes)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        kind, payload = self._call(
+            "get", [r.binary() for r in refs], timeout,
+            timeout=None if timeout is None else timeout + 30.0)
+        result = deserialize(payload)
+        if kind == "exc":
+            raise result
+        return result
+
+    def put(self, value) -> ObjectRef:
+        oid_bin = self._call("put", serialize(value))
+        return ObjectRef(ObjectID(oid_bin))
+
+    def wait(self, refs, num_returns, timeout):
+        ready_bins, not_ready_bins = self._call(
+            "wait", [r.binary() for r in refs], num_returns, timeout,
+            timeout=None if timeout is None else timeout + 30.0)
+        by_id = {r.binary(): r for r in refs}
+        return ([by_id[b] for b in ready_bins],
+                [by_id[b] for b in not_ready_bins])
+
+    def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
+                     max_restarts, max_task_retries, name,
+                     resources=None, strategy=None,
+                     runtime_env=None) -> None:
+        self._call("create_actor", actor_id.binary(), cls_id, cls_bytes,
+                   serialize((args, kwargs, max_restarts,
+                              max_task_retries, name, resources,
+                              strategy, runtime_env)))
+
+    def submit_actor_call(self, actor_id, task_id, method: str, args,
+                          kwargs, num_returns: int) -> None:
+        self._call("submit_actor_call", actor_id.binary(),
+                   task_id.binary(), method, serialize((args, kwargs)),
+                   num_returns)
+
+    def kill_actor(self, actor_id, no_restart: bool = True) -> None:
+        self._call("kill_actor", actor_id.binary(), no_restart)
+
+    def get_actor_id_by_name(self, name: str) -> bytes | None:
+        return self._call("get_actor_by_name", name)
+
+    def cancel_task(self, task_id, force: bool = False) -> None:
+        self._call("cancel", task_id.binary(), force)
+
+    def kv_op(self, op: str, key: bytes, value: bytes | None = None,
+              namespace: str = "", overwrite: bool = True):
+        """internal_kv from a client driver (same surface as workers)."""
+        return self._call("kv", op, key, value, namespace, overwrite)
+
+    # -- introspection (api module functions duck-type onto these) -----------
+    def nodes(self) -> list[dict]:
+        return self._call("nodes")
+
+    def available_resources(self) -> dict:
+        return self._call("available_resources")
+
+    def cluster_resources(self) -> dict:
+        return self._call("cluster_resources")
+
+    def timeline(self) -> list[dict]:
+        return self._call("timeline")
+
+    def status(self) -> dict:
+        return self._call("status")
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+def get_head_actor_id(client: ClientRuntime, name: str):
+    raw = client.get_actor_id_by_name(name)
+    return ActorID(raw) if raw else None
