@@ -6,10 +6,15 @@ actor runtime, two-level scheduling, placement groups, a shared-memory
 object store (native C++ arena, zero-copy worker reads, descriptor pinning,
 LRU spill/restore), an inter-node object plane (directory + pull manager
 with a device-evaluated bandwidth cost model), owner-side reference
-counting with lineage reconstruction, and an autoscaler runtime loop —
-with the scheduling/packing data planes evaluated as dense TPU
-computations (JAX/XLA/Pallas) per BASELINE.json's north star.  Remaining
-reference subsystems are tracked in VERDICT.md and land incrementally.
+counting with lineage reconstruction, an autoscaler runtime loop,
+health-check failure detection, runtime environments, a GCS KV store +
+pubsub, collectives (XLA device-mesh + KV-rendezvous process groups), an
+RPC control plane with a head daemon / client mode / job submission /
+CLI, observability (metrics endpoint, structured logs, Chrome-trace
+timeline), and the library family (``data``, ``train``, ``tune``,
+``serve``, ``rllib``, ``workflow``) — with the scheduling/packing data
+planes evaluated as dense TPU computations (JAX/XLA/Pallas) per
+BASELINE.json's north star.  Remaining gaps are tracked in VERDICT.md.
 
 Public API mirrors the reference's (``ray.init/remote/get/put/wait/...``,
 SURVEY.md §1 layer 9).
@@ -31,7 +36,8 @@ def __getattr__(name):
     if name in _API_NAMES:
         from . import api
         return getattr(api, name)
-    if name in ("util", "experimental"):
+    if name in ("util", "experimental", "data", "train", "tune",
+                "serve", "workflow", "rllib"):
         # NOT `from . import util`: that re-enters __getattr__ via the
         # fromlist hasattr probe before the submodule import finishes.
         # Only submodules that EXIST belong here — forwarding a missing

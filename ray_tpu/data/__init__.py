@@ -1,0 +1,18 @@
+"""ray_tpu.data — distributed datasets over object-store blocks.
+
+Reference parity: ``ray.data`` (``python/ray/data/``) — a ``Dataset`` is
+a list of object-store block references plus metadata; transforms
+(``map/map_batches/filter/flat_map/repartition/random_shuffle/sort``)
+run as tasks over blocks; consumers (``take/count/iter_batches/split``)
+resolve refs (SURVEY.md §1 layer 14, §2.2; mount empty).
+
+TPU-first: blocks are numpy-friendly lists or arrays living in the
+shared-memory arena (zero-copy into workers), ``map_batches`` is the
+primary compute hook so user code sees whole blocks (feed the MXU big
+batches, not Python-loop rows), and ``split`` hands aligned shards to
+``ray_tpu.train`` workers.
+"""
+
+from .dataset import Dataset, from_items, from_numpy, range  # noqa: A004
+
+__all__ = ["Dataset", "from_items", "from_numpy", "range"]

@@ -53,3 +53,9 @@ def _internal_kv_del(key, namespace: str | None = None) -> bool:
 
 def _internal_kv_list(prefix, namespace: str | None = None) -> list[bytes]:
     return _kv("keys", prefix, namespace=namespace)
+
+
+def _internal_kv_incr(key, delta: int = 1,
+                      namespace: str | None = None) -> int:
+    """Atomic counter add; returns the new value."""
+    return int(_kv("incr", key, delta, namespace=namespace))

@@ -1,0 +1,19 @@
+"""ray_tpu.rllib — RL training: parallel rollouts + policy optimization.
+
+Reference parity: ``ray.rllib`` — an ``Algorithm`` owns a set of rollout
+worker ACTORS that step gym-style environments with the current policy,
+gathers their sample batches each iteration, and applies a policy
+update; ``train()`` returns iteration metrics like
+``episode_reward_mean`` (``python/ray/rllib/`` — SURVEY.md §1 layer 14;
+mount empty).
+
+TPU-first: rollouts are Python-on-actors (environment stepping is
+host-bound everywhere), but the POLICY and its update are one jitted
+JAX program — softmax policy gradient with baseline, batched over all
+collected episodes — so the math rides the compiler, and the same
+update shards over a mesh the way ``train.MeshTrainer`` does.
+"""
+
+from .algorithm import Algorithm, PGConfig, RolloutWorker
+
+__all__ = ["Algorithm", "PGConfig", "RolloutWorker"]

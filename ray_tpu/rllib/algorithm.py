@@ -1,0 +1,172 @@
+"""Rollout workers + REINFORCE-with-baseline on a JAX softmax policy.
+
+The rollout plane mirrors the reference (worker actors step envs with
+policy weights broadcast each iteration, samples return through the
+object store); the learner is a single jitted update over the batched
+episodes (SURVEY.md §1 layer 14; mount empty).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+def _softmax_logits(params, obs):
+    import jax.numpy as jnp
+    return obs @ params["w"] + params["b"]
+
+
+def _sample_action(params, obs, rng: np.random.Generator) -> int:
+    logits = np.asarray(_softmax_logits(
+        {k: np.asarray(v) for k, v in params.items()}, obs))
+    z = logits - logits.max()
+    p = np.exp(z) / np.exp(z).sum()
+    return int(rng.choice(len(p), p=p))
+
+
+class RolloutWorker:
+    """Actor: steps its own env copies with the broadcast policy."""
+
+    def __init__(self, env_creator_bytes: bytes, seed: int):
+        from ..runtime.serialization import deserialize
+        self._env = deserialize(env_creator_bytes)()
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, params: dict, num_episodes: int,
+               horizon: int) -> list[dict]:
+        """Roll ``num_episodes`` episodes; returns per-episode
+        {obs, actions, rewards} arrays."""
+        episodes = []
+        for _ in range(num_episodes):
+            obs_list, act_list, rew_list = [], [], []
+            obs = self._env.reset()
+            for _ in range(horizon):
+                a = _sample_action(params, np.asarray(obs), self._rng)
+                nxt, r, done = self._env.step(a)
+                obs_list.append(np.asarray(obs))
+                act_list.append(a)
+                rew_list.append(r)
+                obs = nxt
+                if done:
+                    break
+            episodes.append({
+                "obs": np.asarray(obs_list, dtype=np.float32),
+                "actions": np.asarray(act_list, dtype=np.int32),
+                "rewards": np.asarray(rew_list, dtype=np.float32)})
+        return episodes
+
+
+@dataclass
+class PGConfig:
+    env_creator: Callable = None
+    obs_dim: int = 0
+    num_actions: int = 0
+    num_workers: int = 2
+    episodes_per_worker: int = 8
+    horizon: int = 64
+    gamma: float = 0.99
+    lr: float = 0.05
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+class Algorithm:
+    def __init__(self, config: PGConfig):
+        import jax
+        import ray_tpu
+        from ..runtime.serialization import serialize
+        if config.env_creator is None or config.obs_dim <= 0 \
+                or config.num_actions <= 0:
+            raise ValueError(
+                "PGConfig needs env_creator, obs_dim, num_actions")
+        self.config = config
+        rng = np.random.default_rng(config.seed)
+        self._params = {
+            "w": (0.01 * rng.normal(size=(config.obs_dim,
+                                          config.num_actions))
+                  ).astype(np.float32),
+            "b": np.zeros(config.num_actions, dtype=np.float32)}
+        worker_cls = ray_tpu.remote(RolloutWorker)
+        env_bytes = serialize(config.env_creator)
+        self._workers = [worker_cls.remote(env_bytes, config.seed + i)
+                         for i in range(config.num_workers)]
+        self._update = jax.jit(self._make_update())
+        self.iteration = 0
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        lr = self.config.lr
+
+        def update(params, obs, actions, returns, mask):
+            def neg_objective(p):
+                logits = _softmax_logits(p, obs)       # (T, A)
+                logp = jax.nn.log_softmax(logits)
+                chosen = jnp.take_along_axis(
+                    logp, actions[:, None], axis=1)[:, 0]
+                # advantage = return - batch baseline (variance cut)
+                denom = jnp.maximum(mask.sum(), 1.0)
+                baseline = (returns * mask).sum() / denom
+                adv = (returns - baseline) * mask
+                return -(chosen * adv).sum() / denom
+            grads = jax.grad(neg_objective)(params)
+            return jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+
+        return update
+
+    def train(self) -> dict:
+        """One iteration: parallel rollouts -> batched PG update."""
+        import ray_tpu
+        cfg = self.config
+        params = {k: np.asarray(v) for k, v in self._params.items()}
+        batches = ray_tpu.get(
+            [w.sample.remote(params, cfg.episodes_per_worker,
+                             cfg.horizon) for w in self._workers],
+            timeout=300)
+        episodes = [ep for b in batches for ep in b]
+        # flatten all timesteps; per-step discounted return-to-go
+        obs, acts, rets = [], [], []
+        ep_rewards = []
+        for ep in episodes:
+            r = ep["rewards"]
+            ep_rewards.append(float(r.sum()))
+            g = np.zeros_like(r)
+            acc = 0.0
+            for t in range(len(r) - 1, -1, -1):
+                acc = r[t] + cfg.gamma * acc
+                g[t] = acc
+            obs.append(ep["obs"])
+            acts.append(ep["actions"])
+            rets.append(g)
+        obs = np.concatenate(obs)
+        acts = np.concatenate(acts)
+        rets = np.concatenate(rets).astype(np.float32)
+        mask = np.ones(len(rets), dtype=np.float32)
+        self._params = self._update(self._params, obs, acts, rets, mask)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "episodes_this_iter": len(episodes),
+                "timesteps_this_iter": int(len(rets)),
+                "episode_reward_mean": float(np.mean(ep_rewards)),
+                "episode_reward_max": float(np.max(ep_rewards)),
+                "episode_reward_min": float(np.min(ep_rewards))}
+
+    def get_policy_params(self) -> dict:
+        return {k: np.asarray(v) for k, v in self._params.items()}
+
+    def compute_single_action(self, obs,
+                              rng: np.random.Generator | None = None) \
+            -> int:
+        rng = rng or np.random.default_rng(0)
+        return _sample_action(self.get_policy_params(),
+                              np.asarray(obs), rng)
+
+    def stop(self) -> None:
+        import ray_tpu
+        for w in self._workers:
+            ray_tpu.kill(w)
+        self._workers = []

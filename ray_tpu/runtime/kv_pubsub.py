@@ -49,6 +49,19 @@ class KVStore:
         with self._lock:
             return self._data.pop((namespace, bytes(key)), None) is not None
 
+    def incr(self, key: bytes, delta: int = 1,
+             namespace: str = "") -> int:
+        """Atomic counter add; returns the new value (missing key
+        counts from 0).  The GCS-side primitive concurrent clients
+        (serve load accounting) need — read-modify-write through
+        get/put would lose updates."""
+        k = (namespace, bytes(key))
+        with self._lock:
+            cur = int(self._data.get(k, b"0"))
+            cur += int(delta)
+            self._data[k] = str(cur).encode()
+            return cur
+
     def keys(self, prefix: bytes = b"", namespace: str = "") -> list[bytes]:
         prefix = bytes(prefix)
         with self._lock:
@@ -71,6 +84,8 @@ class KVStore:
             return self.exists(key, namespace)
         if op == "keys":
             return self.keys(key, namespace)
+        if op == "incr":
+            return self.incr(key, int(value), namespace)
         raise ValueError(f"unknown kv op {op!r}")
 
     def snapshot(self) -> dict:

@@ -1,0 +1,17 @@
+"""ray_tpu.serve — scalable deployments over actor replica pools.
+
+Reference parity: ``ray.serve`` (``python/ray/serve/``) —
+``@serve.deployment`` wraps a class/function, ``.bind(...)`` builds an
+application graph, ``serve.run`` materializes it as a controller +
+replica actors, ``DeploymentHandle.remote`` routes requests across
+replicas, autoscaling tracks ongoing requests against a target, and
+handles compose (a deployment takes another's handle) — SURVEY.md §1
+layer 14; mount empty.
+"""
+
+from .deployment import (Application, Deployment, DeploymentHandle,
+                         delete, deployment, get_deployment_handle, run,
+                         status)
+
+__all__ = ["Application", "Deployment", "DeploymentHandle", "delete",
+           "deployment", "get_deployment_handle", "run", "status"]

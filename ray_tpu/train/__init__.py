@@ -1,0 +1,27 @@
+"""ray_tpu.train — distributed training orchestration, TPU-first.
+
+Reference parity: ``ray.train`` (``python/ray/train/``) — a ``Trainer``
+runs ``train_loop_per_worker`` on a gang of worker actors sized by
+``ScalingConfig``, workers sync gradients through a collective backend,
+report metrics/checkpoints via ``train.report``, and ``fit()`` returns a
+``Result`` (SURVEY.md §1 layer 14, §2.4 DP row; mount empty).
+
+Two trainers, both real:
+
+- **JaxTrainer** — the reference shape: N worker actors placed as a
+  PACK gang, per-worker dataset shards, gradient allreduce over the
+  ``ray_tpu.util.collective`` process group.
+- **MeshTrainer** — the TPU-first shape: ONE process, N devices;
+  the training step is compiled with ``shard_map`` over a
+  ``jax.sharding.Mesh`` (batch sharded on the data axis, grads
+  ``pmean``-ed over ICI, params replicated) so data parallelism is an
+  XLA collective, not N Python processes.
+"""
+
+from .checkpoint import Checkpoint
+from .mesh import MeshTrainer
+from .trainer import (JaxTrainer, Result, ScalingConfig, get_context,
+                      report)
+
+__all__ = ["Checkpoint", "JaxTrainer", "MeshTrainer", "Result",
+           "ScalingConfig", "get_context", "report"]

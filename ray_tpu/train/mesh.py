@@ -1,0 +1,131 @@
+"""MeshTrainer: data-parallel SPMD training as one XLA program.
+
+The TPU-first counterpart of the actor-gang trainer: instead of N
+Python worker processes exchanging gradients through a host-side
+collective (the reference's torch-DDP shape), the step is compiled once
+with ``shard_map`` over a ``jax.sharding.Mesh`` — the global batch is
+sharded on the ``data`` axis, every device computes grads on its shard,
+``lax.pmean`` averages them over ICI, and the optimizer update runs
+replicated.  Scaling to a pod slice is the SAME program over a larger
+mesh (SURVEY.md §2.3/§2.4 TPU-native equivalents; mount empty).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+from .trainer import Result
+
+
+class MeshTrainer:
+    def __init__(self, loss_fn: Callable, init_params,
+                 *, optimizer=None, devices=None):
+        """``loss_fn(params, batch) -> scalar``; ``optimizer`` is an
+        optax GradientTransformation (default: sgd(1e-2))."""
+        import jax
+        import optax
+        from jax.sharding import Mesh
+        self._loss_fn = loss_fn
+        self._params = init_params
+        self._opt = optimizer if optimizer is not None \
+            else optax.sgd(1e-2)
+        self._opt_state = self._opt.init(init_params)
+        devs = list(devices) if devices is not None else jax.devices()
+        self._mesh = Mesh(np.array(devs), ("data",))
+        self.n_devices = len(devs)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        import jax
+        import optax
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map          # jax >= 0.8
+            smap = partial(shard_map, check_vma=False)
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+            smap = partial(shard_map, check_rep=False)
+
+        loss_fn, opt = self._loss_fn, self._opt
+
+        def per_device(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # the collective IS the gradient sync: pmean over the data
+            # axis rides ICI on hardware
+            grads = jax.lax.pmean(grads, "data")
+            loss = jax.lax.pmean(loss, "data")
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(smap(
+            per_device, mesh=self._mesh,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P(), P(), P())))
+
+    def step(self, batch):
+        """One global-batch step; returns the (replicated) loss."""
+        batch = self._shardable(batch)
+        self._params, self._opt_state, loss = self._step(
+            self._params, self._opt_state, batch)
+        return float(loss)
+
+    def _shardable(self, batch):
+        """Trim the leading axis to a multiple of the mesh size (static
+        shapes: XLA compiles one program per distinct batch shape)."""
+        import jax
+        n = self.n_devices
+
+        def trim(x):
+            x = np.asarray(x)
+            keep = (x.shape[0] // n) * n
+            if keep == 0:
+                raise ValueError(
+                    f"batch of {x.shape[0]} rows cannot shard over "
+                    f"{n} devices")
+            return x[:keep]
+        return jax.tree_util.tree_map(trim, batch)
+
+    @property
+    def params(self):
+        return self._params
+
+    def fit(self, dataset, *, epochs: int = 1,
+            global_batch_size: int = 256) -> Result:
+        """Train over a ``ray_tpu.data.Dataset`` (or ndarray batch
+        source): batches stream from the object store, every step is
+        one compiled SPMD program."""
+        history: list[dict] = []
+        loss = float("nan")
+        for epoch in range(epochs):
+            losses = []
+            for batch in self._batches(dataset, global_batch_size):
+                losses.append(self.step(batch))
+            loss = float(np.mean(losses)) if losses else float("nan")
+            history.append({"epoch": epoch, "loss": loss})
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint=Checkpoint({"params": self._params,
+                                   "opt_state": self._opt_state}),
+            history=history)
+
+    def _batches(self, dataset, batch_size: int):
+        if hasattr(dataset, "iter_batches"):
+            # drop the ragged tail: static shapes keep XLA at one
+            # compiled program per epoch
+            for batch in dataset.iter_batches(batch_size=batch_size):
+                if len(batch) == batch_size:
+                    yield batch
+        else:
+            arr = np.asarray(dataset)
+            for i in range(0, len(arr) - batch_size + 1, batch_size):
+                yield arr[i:i + batch_size]
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        state = checkpoint.to_dict()
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]

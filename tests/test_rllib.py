@@ -1,0 +1,88 @@
+"""ray_tpu.rllib: parallel rollouts + policy-gradient learning.
+
+Scenario sources: upstream ``ray.rllib`` contract — Algorithm over
+rollout worker actors, train() iterations returning episode_reward
+metrics, learned policies beating random (SURVEY.md §1 layer 14;
+scenarios re-derived, not copied)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import Algorithm, PGConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def driver():
+    ray_tpu.init(resources={"CPU": 8, "memory": 8}, num_workers=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TwoArmBandit:
+    """Arm 1 pays 1.0, arm 0 pays 0.1; one-step episodes."""
+
+    def reset(self):
+        return np.array([1.0], dtype=np.float32)
+
+    def step(self, action):
+        reward = 1.0 if action == 1 else 0.1
+        return np.array([1.0], dtype=np.float32), reward, True
+
+
+class Corridor:
+    """Walk right to the goal at x=4; -0.05 per step, +1 at goal."""
+
+    def reset(self):
+        self.x = 0
+        return self._obs()
+
+    def _obs(self):
+        return np.array([self.x / 4.0, 1.0], dtype=np.float32)
+
+    def step(self, action):
+        self.x += 1 if action == 1 else -1
+        self.x = max(self.x, 0)
+        if self.x >= 4:
+            return self._obs(), 1.0, True
+        return self._obs(), -0.05, False
+
+
+class TestPolicyGradient:
+    def test_bandit_learns_best_arm(self):
+        algo = Algorithm(PGConfig(
+            env_creator=TwoArmBandit, obs_dim=1, num_actions=2,
+            num_workers=2, episodes_per_worker=16, horizon=1,
+            lr=0.5, seed=0))
+        try:
+            first = algo.train()
+            assert first["training_iteration"] == 1
+            assert first["episodes_this_iter"] == 32
+            for _ in range(14):
+                last = algo.train()
+            # converged to the paying arm: mean reward near 1.0
+            assert last["episode_reward_mean"] > 0.9
+            picks = [algo.compute_single_action(
+                np.array([1.0]), np.random.default_rng(i))
+                for i in range(20)]
+            assert sum(picks) >= 18
+        finally:
+            algo.stop()
+
+    def test_corridor_improves(self):
+        algo = Algorithm(PGConfig(
+            env_creator=Corridor, obs_dim=2, num_actions=2,
+            num_workers=2, episodes_per_worker=8, horizon=30,
+            lr=0.2, gamma=0.95, seed=1))
+        try:
+            rewards = [algo.train()["episode_reward_mean"]
+                       for _ in range(20)]
+            # late performance beats early (policy moved toward goal)
+            assert np.mean(rewards[-5:]) > np.mean(rewards[:5])
+            assert np.mean(rewards[-5:]) > 0.5
+        finally:
+            algo.stop()
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="needs env_creator"):
+            Algorithm(PGConfig())

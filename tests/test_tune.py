@@ -1,0 +1,126 @@
+"""ray_tpu.tune: search spaces, parallel trials, ASHA.
+
+Scenario sources: upstream ``ray.tune`` API contract — Tuner/fit,
+grid/stochastic sampling, per-iteration report, checkpoint resume, ASHA
+early stopping, ResultGrid.get_best_result (SURVEY.md §1 layer 14;
+scenarios re-derived, not copied)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module", autouse=True)
+def driver():
+    ray_tpu.init(resources={"CPU": 8, "memory": 8}, num_workers=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestSearchSpace:
+    def test_expand_grid_cross_product(self):
+        from ray_tpu.tune.search import expand
+        cfgs = expand({"a": tune.grid_search([1, 2]),
+                       "b": tune.grid_search(["x", "y"]),
+                       "c": 7}, num_samples=1, seed=0)
+        assert len(cfgs) == 4
+        assert {(c["a"], c["b"]) for c in cfgs} == \
+            {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+        assert all(c["c"] == 7 for c in cfgs)
+
+    def test_stochastic_domains(self):
+        from ray_tpu.tune.search import expand
+        cfgs = expand({"lr": tune.loguniform(1e-4, 1e-1),
+                       "n": tune.randint(1, 10),
+                       "opt": tune.choice(["sgd", "adam"])},
+                      num_samples=20, seed=1)
+        assert len(cfgs) == 20
+        assert all(1e-4 <= c["lr"] <= 1e-1 for c in cfgs)
+        assert all(1 <= c["n"] < 10 for c in cfgs)
+        assert {c["opt"] for c in cfgs} <= {"sgd", "adam"}
+
+
+def _quadratic(config):
+    # minimum at x = 3
+    loss = (config["x"] - 3.0) ** 2
+    tune.report({"loss": loss, "x": config["x"]})
+
+
+class TestFifo:
+    def test_grid_finds_minimum(self):
+        grid = tune.Tuner(
+            _quadratic,
+            param_space={"x": tune.grid_search(
+                [0.0, 1.0, 2.0, 3.0, 4.0])},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        ).fit()
+        assert len(grid) == 5
+        best = grid.get_best_result()
+        assert best.config["x"] == 3.0
+        assert best.metrics["loss"] == 0.0
+
+    def test_run_wrapper_and_dataframe(self):
+        grid = tune.run(_quadratic,
+                        param_space={"x": tune.grid_search([1.0, 5.0])},
+                        metric="loss", mode="min")
+        rows = grid.get_dataframe()
+        assert len(rows) == 2
+        assert {r["config/x"] for r in rows} == {1.0, 5.0}
+
+
+def _iterative(config):
+    """SGD on a 1-d quadratic, resumable from a checkpoint: ASHA must
+    find the best lr without running every trial to max_t."""
+    ckpt = tune.get_checkpoint()
+    state = ckpt.to_dict() if ckpt is not None else \
+        {"x": 10.0, "iter": 0}
+    x, start = state["x"], state["iter"]
+    for i in range(start, config["tune_iterations"]):
+        x = x - config["lr"] * 2.0 * x      # d/dx x^2
+        tune.report({"loss": x * x, "iteration": i + 1})
+    tune.report({"loss": x * x, "iteration": config["tune_iterations"]},
+                checkpoint=tune.Checkpoint(
+                    {"x": x, "iter": config["tune_iterations"]}))
+
+
+class TestAsha:
+    def test_asha_promotes_best_and_stops_worst(self):
+        grid = tune.Tuner(
+            _iterative,
+            param_space={"lr": tune.grid_search(
+                [0.001, 0.01, 0.1, 0.4])},
+            tune_config=tune.TuneConfig(
+                metric="loss", mode="min",
+                scheduler=tune.ASHAScheduler(
+                    max_t=16, grace_period=2, reduction_factor=4)),
+        ).fit()
+        assert len(grid) == 4
+        best = grid.get_best_result()
+        assert best.config["lr"] == 0.4     # fastest descent wins
+        # early-stopped trials ran fewer total iterations than the
+        # promoted one (the point of successive halving)
+        budgets = {r.config["lr"]: r.metrics.get("iteration", 0)
+                   for r in grid}
+        assert budgets[0.4] == 16
+        assert sum(1 for v in budgets.values() if v < 16) >= 2
+
+    def test_checkpoint_resume_continues_not_restarts(self):
+        grid = tune.Tuner(
+            _iterative,
+            param_space={"lr": tune.grid_search([0.1, 0.2])},
+            tune_config=tune.TuneConfig(
+                metric="loss", mode="min",
+                scheduler=tune.ASHAScheduler(
+                    max_t=8, grace_period=2, reduction_factor=4)),
+        ).fit()
+        best = grid.get_best_result()
+        # promoted trial's history shows iterations 1..8 continuous
+        iters = [r["iteration"] for r in best.history if "iteration"
+                 in r]
+        assert max(iters) == 8
+        x = best.checkpoint.to_dict()["x"]
+        lr = best.config["lr"]
+        expect = 10.0 * (1 - 2 * lr) ** 8
+        np.testing.assert_allclose(x, expect, rtol=1e-10)
