@@ -281,6 +281,72 @@ class Cluster:
         target.enqueue_local(task_id)
         return True
 
+    # -- GCS persistence -----------------------------------------------------
+    def save_gcs_snapshot(self, path: str) -> str:
+        """Persist the GCS metadata plane — KV table, function/class
+        registry, live named-actor creation specs — the reference's
+        Redis-backed GCS fault tolerance (``RedisStoreClient``,
+        SURVEY.md §5.4).  Object-store contents and running tasks are
+        NOT persisted (upstream behaves the same: objects re-derive
+        from lineage or are lost; detached actors RESTART)."""
+        import pickle
+        # actor specs BEFORE the registry copy: create_actor registers
+        # class bytes before the record becomes visible, so every spec
+        # captured here is guaranteed resolvable in the later registry
+        # snapshot (the reverse order can capture an actor whose bytes
+        # missed the copy)
+        named = (self.actor_manager.named_actor_specs()
+                 if self.actor_manager else [])
+        snap = {"named_actors": named,
+                "fn_registry": dict(self.fn_registry),
+                "kv": self.kv.snapshot()}
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+        os.replace(tmp, path)       # atomic: no torn snapshot
+        return path
+
+    def restore_gcs_snapshot(self, path: str) -> None:
+        """Load a snapshot into THIS cluster: KV + registry restore
+        in-place; named actors are RE-CREATED (fresh incarnation, ctor
+        re-runs — reference detached-actor restart semantics).
+        Requires an attached actor_manager and at least one node."""
+        import pickle
+
+        from .common.ids import ActorID, JobID
+        from .runtime.serialization import deserialize
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        # validate EVERYTHING before mutating anything: a failed restore
+        # must not leave the cluster half its own state, half snapshot
+        if self.actor_manager is None and snap["named_actors"]:
+            raise RuntimeError("attach an actor manager (ray_tpu.init) "
+                               "before restoring named actors")
+        for spec in snap["named_actors"]:
+            if spec["cls_id"] not in snap["fn_registry"]:
+                raise RuntimeError(
+                    f"snapshot is missing class bytes for named actor "
+                    f"{spec['name']!r}")
+        self.kv.restore(snap["kv"])
+        for fn_id, fn_bytes in snap["fn_registry"].items():
+            self.fn_registry.setdefault(fn_id, fn_bytes)
+        job_id = JobID.next()
+        skipped = []
+        for spec in snap["named_actors"]:
+            if self.actor_manager.get_by_name(spec["name"]) is not None:
+                skipped.append(spec["name"])    # live actor wins
+                continue
+            args, kwargs = deserialize(spec["init"])
+            self.actor_manager.create_actor(
+                ActorID.of(job_id), spec["cls_id"],
+                self.fn_registry.get(spec["cls_id"]), args, kwargs,
+                spec["max_restarts"], spec["max_task_retries"],
+                spec["name"], resources=spec["resources"],
+                runtime_env=spec["runtime_env"])
+        if skipped:
+            self.events.emit("gcs", "restore_skipped_actors",
+                             names=skipped)
+
     # -- teardown -----------------------------------------------------------
     def stop(self) -> None:
         self.health.shutdown()

@@ -535,6 +535,29 @@ class ActorManager:
             rec = self._actors.get(actor_id)
             return rec.state if rec else None
 
+    def named_actor_specs(self) -> list[dict]:
+        """Creation specs of live NAMED actors — what a GCS snapshot
+        persists so a restored head can re-create them (the reference's
+        Redis-backed FT restarts detached actors from their registered
+        specs; state is NOT resurrected, the ctor re-runs).  Class
+        bytes travel via the fn-registry snapshot; PG strategies are
+        dropped (the group does not survive the restart)."""
+        from .serialization import serialize
+        with self._lock:
+            out = []
+            for rec in self._actors.values():
+                if rec.name is None or rec.state is ActorState.DEAD:
+                    continue
+                out.append({
+                    "name": rec.name,
+                    "cls_id": rec.cls_id,
+                    "init": serialize((rec.init_args, rec.init_kwargs)),
+                    "max_restarts": rec.max_restarts,
+                    "max_task_retries": rec.max_task_retries,
+                    "resources": rec.resources,
+                    "runtime_env": rec.runtime_env})
+            return out
+
     def list_actors(self) -> list[dict]:
         with self._lock:
             return [{

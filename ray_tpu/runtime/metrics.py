@@ -113,6 +113,10 @@ def render_metrics(cluster) -> str:
     if ev is not None:
         _fmt("events_emitted_total", ev.num_events,
              "Structured events emitted (cumulative)", out=out)
+
+    # user-defined metrics (ray_tpu.util.metrics) share the endpoint
+    from ..util.metrics import render_user_metrics
+    out.extend(render_user_metrics())
     return "\n".join(out) + "\n"
 
 

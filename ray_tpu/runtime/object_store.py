@@ -456,6 +456,20 @@ class MemoryStore:
         with self._cv:
             return len(self._objects)
 
+    def list_objects(self) -> list[tuple]:
+        """(object_id, size_bytes, kind) rows for the state API; size
+        is known for shm/spilled entries, -1 for in-band values."""
+        with self._cv:
+            out = []
+            for oid, entry in self._objects.items():
+                if isinstance(entry, ShmEntry):
+                    out.append((oid, entry.size, "shm"))
+                elif isinstance(entry, SpillEntry):
+                    out.append((oid, entry.size, "spilled"))
+                else:
+                    out.append((oid, -1, "in_band"))
+            return out
+
     def stats(self) -> dict:
         with self._cv:
             shm = sum(isinstance(e, ShmEntry)

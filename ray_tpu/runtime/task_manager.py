@@ -50,6 +50,27 @@ class TaskManager:
             self._records[spec.task_id] = rec
         return rec
 
+    def list_rows(self) -> list[dict]:
+        """State-API rows for every live record (pending/running +
+        lineage-retained finished) — keeps the storage layout private."""
+        with self._lock:
+            records = list(self._records.items()) + \
+                list(self._done.items())
+        rows, seen = [], set()
+        for tid, rec in records:
+            if tid in seen:
+                continue
+            seen.add(tid)
+            rows.append({
+                "task_id": tid.hex(),
+                "name": rec.spec.function_descriptor,
+                "state": "FINISHED" if rec.done
+                else "PENDING_OR_RUNNING",
+                "num_returns": rec.spec.num_returns,
+                "retries_left": rec.retries_left,
+                "resources": rec.spec.resources.to_dict()})
+        return rows
+
     def get(self, task_id: TaskID) -> TaskRecord | None:
         with self._lock:
             return self._records.get(task_id)
