@@ -1,0 +1,183 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference orchestrates frameworks that do long-context training but
+contains no sequence parallelism itself (SURVEY.md §5.7); this rebuild
+makes it first-class because the TPU mesh makes it natural:
+
+- **Ring attention** (blockwise attention with K/V rotation): the
+  sequence axis is sharded over a mesh axis; each device computes
+  attention of its query block against one K/V block at a time while
+  K/V blocks rotate around the ring via ``lax.ppermute`` (neighbor
+  exchanges ride ICI), accumulating with an online softmax — exact
+  attention over sequences ``world_size``× longer than one device's
+  memory, compute/communication overlapped by XLA pipelining.
+
+- **Ulysses all-to-all**: ``lax.all_to_all`` reshards
+  sequence-parallel activations to HEAD-parallel, runs ordinary full
+  attention on each device's head slice, and reshards back — the
+  all-to-all alternative for models with enough heads.
+
+Both are exact (tested bit-close against single-device full attention)
+and compile to one XLA program under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+
+def _smap():
+    from ..util.jax_compat import shard_map_compat
+    return shard_map_compat()
+
+
+def full_attention(q, k, v, causal: bool = False, precision=None):
+    """Single-device reference: softmax(QK^T / sqrt(d)) V.
+
+    Shapes ``(batch, seq, heads, dim)``.  ``precision``: a
+    ``jax.lax.Precision`` for the matmuls — on TPU the default runs
+    bf16 MXU passes, which makes BLOCKWISE accumulation (ring) differ
+    from the one-shot softmax at ~1e-3; pass ``HIGHEST`` when exact
+    agreement matters more than throughput.
+    """
+    import jax
+    import jax.numpy as jnp
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        precision=precision) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(tk)[None, :] > jnp.arange(tq)[:, None]
+        scores = jnp.where(mask, -jnp.inf, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=precision)
+
+
+def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
+                          causal: bool, precision=None):
+    """Per-device body: q/k/v are this device's sequence block
+    ``(batch, block, heads, dim)``."""
+    import jax
+    import jax.numpy as jnp
+
+    block = q.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    my = jax.lax.axis_index(axis_name)
+    q_pos = my * block + jnp.arange(block)              # global q rows
+
+    def fold(s, k_blk, v_blk, acc, denom, m):
+        """Online-softmax accumulation of one K/V block (the block
+        held after ``s`` rotations = rank ``my - s``'s)."""
+        kv_rank = (my - s) % axis_size
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                            precision=precision) * scale
+        if causal:
+            k_pos = kv_rank * block + jnp.arange(block)
+            bad = k_pos[None, :] > q_pos[:, None]       # future keys
+            scores = jnp.where(bad[None, None], -jnp.inf, scores)
+        blk_max = scores.max(axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # fully-masked rows keep -inf max; exp(-inf - -inf) guards
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk, precision=precision)
+        denom = denom * corr + p.sum(axis=-1)
+        return acc, denom, new_m
+
+    def step(s, carry):
+        k_blk, v_blk, acc, denom, m = carry
+        acc, denom, m = fold(s, k_blk, v_blk, acc, denom, m)
+        # rotate K/V to the next device (neighbor exchange over ICI)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, acc, denom, m
+
+    b, t, h, d = q.shape
+    init = (k, v,
+            jnp.zeros((b, h, t, d), q.dtype),
+            jnp.zeros((b, h, t), q.dtype),
+            jnp.full((b, h, t), -jnp.inf, q.dtype))
+    # loop runs axis_size-1 [fold + rotate] rounds; the LAST block
+    # folds outside the loop so no wasted final exchange rides the ring
+    k_blk, v_blk, acc, denom, m = jax.lax.fori_loop(
+        0, axis_size - 1, step, init)
+    acc, denom, _ = fold(axis_size - 1, k_blk, v_blk, acc, denom, m)
+    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3)                    # -> (b, t, h, d)
+
+
+# jitted program cache: jax.jit keys on the wrapped FUNCTION OBJECT, so
+# rebuilding partial+shard_map+jit per call would retrace and recompile
+# every invocation (same pattern as DeviceCollectiveGroup._sharded)
+_compiled: dict = {}
+
+
+def ring_attention(q, k, v, *, mesh, axis_name: str = "sp",
+                   causal: bool = False, precision=None):
+    """Exact attention with the SEQUENCE axis sharded over
+    ``mesh[axis_name]``; inputs/outputs ``(batch, seq, heads, dim)``
+    with seq = world * block."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    axis_size = mesh.shape[axis_name]
+    key = ("ring", mesh, axis_name, causal, precision)
+    fn = _compiled.get(key)
+    if fn is None:
+        body = partial(_ring_attention_shard, axis_name=axis_name,
+                       axis_size=axis_size, causal=causal,
+                       precision=precision)
+        spec = P(None, axis_name, None, None)
+        fn = jax.jit(_smap()(body, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+        _compiled[key] = fn
+    return fn(q, k, v)
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool,
+                   precision=None):
+    """Per-device body: reshard seq-parallel -> head-parallel with
+    all-to-all, attend fully, reshard back."""
+    import jax
+
+    def a2a(x, forward: bool):
+        # (b, block, H, d) <-> (b, seq, H/w, d): split one axis across
+        # the mesh, gather the other — one fused ICI all-to-all
+        if forward:
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = a2a(q, True), a2a(k, True), a2a(v, True)
+    out = full_attention(qh, kh, vh, causal=causal, precision=precision)
+    return a2a(out, False)
+
+
+def ulysses_attention(q, k, v, *, mesh, axis_name: str = "sp",
+                      causal: bool = False, precision=None):
+    """Exact attention via all-to-all head resharding; requires
+    ``heads % world == 0``.  Same layout contract as
+    ``ring_attention``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    world = mesh.shape[axis_name]
+    if q.shape[2] % world != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the mesh "
+            f"axis ({world})")
+    key = ("ulysses", mesh, axis_name, causal, precision)
+    fn = _compiled.get(key)
+    if fn is None:
+        body = partial(_ulysses_shard, axis_name=axis_name,
+                       causal=causal, precision=precision)
+        spec = P(None, axis_name, None, None)
+        fn = jax.jit(_smap()(body, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+        _compiled[key] = fn
+    return fn(q, k, v)

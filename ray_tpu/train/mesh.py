@@ -12,7 +12,6 @@ mesh (SURVEY.md §2.3/§2.4 TPU-native equivalents; mount empty).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import numpy as np
@@ -43,12 +42,9 @@ class MeshTrainer:
         import jax
         import optax
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map          # jax >= 0.8
-            smap = partial(shard_map, check_vma=False)
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
-            smap = partial(shard_map, check_rep=False)
+
+        from ..util.jax_compat import shard_map_compat
+        smap = shard_map_compat()
 
         loss_fn, opt = self._loss_fn, self._opt
 

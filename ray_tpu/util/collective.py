@@ -65,13 +65,11 @@ class DeviceCollectiveGroup:
     def _sharded(self, fn, key):
         import jax
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map              # jax >= 0.8
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
+
+        from .jax_compat import shard_map_compat
         cached = self._cache.get(key)
         if cached is None:
-            cached = jax.jit(shard_map(
+            cached = jax.jit(shard_map_compat(check=True)(
                 fn, mesh=self._mesh, in_specs=P("ranks"),
                 out_specs=P("ranks")))
             self._cache[key] = cached
