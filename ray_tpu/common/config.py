@@ -107,6 +107,13 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 0,
         "Worker pool size; 0 => os.cpu_count()."),
     "worker_lease_timeout_ms": (int, 10_000, "Lease RPC timeout."),
+    "worker_pipeline_depth": (
+        int, 2,
+        "Max tasks committed to one worker: 1 executing + N-1 queued "
+        "raylet-side, sent the moment the previous result lands — "
+        "removes the result->rescan->dispatch round trip from the "
+        "tiny-task critical path (reference: submitters pipeline tasks "
+        "onto cached leases, SURVEY §3.2).  1 disables."),
     "env_worker_grace_ms": (
         int, 50,
         "How long a queued task waits for a busy same-env worker to "

@@ -66,6 +66,11 @@ class Raylet:
         # the grace before the cache grows)
         self._env_miss_since: dict[TaskID, float] = {}
         self._env_staging: set[str] = set()     # env keys staging off-thread
+        # count of pipelined-lease entries across all workers: while
+        # nonzero the event loop wakes periodically to reconcile
+        # entries stranded by commit races (worker released/blocked/
+        # died between pipeline_target and the commit)
+        self._assigned_total = 0
         self._avoid_local: set[TaskID] = set()  # lease-spilled: skip here
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
@@ -285,7 +290,14 @@ class Raylet:
                     if self._stopped or (self._dirty and
                                          (self._queue or self._local_queue)):
                         break
-                    self._cv.wait()
+                    # while pipelined-lease entries exist, wake on a
+                    # timer too: a commit that raced a worker-state
+                    # change has no other wake-up to recall it
+                    if self._assigned_total > 0:
+                        if not self._cv.wait(0.2):
+                            break       # timed out: reconcile below
+                    else:
+                        self._cv.wait()
                 if self._stopped:
                     return
                 self._dirty = False
@@ -293,6 +305,7 @@ class Raylet:
                 self._queue.clear()
             round_t0 = time.monotonic()
             try:
+                self._reconcile_assigned()
                 if batch:
                     leftover = self._place_batch(batch)
                     if leftover:
@@ -591,6 +604,44 @@ class Raylet:
             else:
                 worker = self.pool.pop_idle()
                 if worker is None:
+                    # pipelined lease: commit the task to a BUSY worker's
+                    # soft queue (resources stay debited); the exec frame
+                    # ships the instant that worker's current result
+                    # lands, cutting the result->rescan->dispatch round
+                    # trip out of the tiny-task critical path
+                    depth = get_config().worker_pipeline_depth
+                    target = self.pool.pipeline_target(None, depth) \
+                        if depth > 1 else None
+                    if target is not None:
+                        committed = False
+                        with self._cv:
+                            # re-validate AT COMMIT: the target may have
+                            # died/blocked/been released since selection
+                            # (the reconcile sweep covers what still
+                            # slips through this non-atomic check)
+                            if not target.dead and not target.blocked \
+                                    and target.leased_task is not None:
+                                try:
+                                    self._local_queue.remove(task_id)
+                                except ValueError:
+                                    self.crm.add_back(self.row,
+                                                      spec.resources)
+                                    continue
+                                self._local_since.pop(task_id, None)
+                                self._env_miss_since.pop(task_id, None)
+                                self._planned_add(spec.resources, -1)
+                                target.assigned.append(
+                                    (task_id, time.monotonic()))
+                                self._assigned_total += 1
+                                committed = True
+                        if committed:
+                            # removal shifted queue indices: do NOT
+                            # bump `scanned`, or the next task gets
+                            # skipped for the rest of this pass
+                            continue
+                        self.crm.add_back(self.row, spec.resources)
+                        self._spill_stale_leases()
+                        return
                     self.crm.add_back(self.row, spec.resources)
                     # worker-limited: park, but tasks that waited past the
                     # lease timeout spill back to global placement
@@ -795,26 +846,126 @@ class Raylet:
         timeout = get_config().worker_lease_timeout_ms / 1000.0
         now = time.monotonic()
         moved = []
+        multi_node = len(self.cluster.raylets) > 1
         with self._cv:
-            if len(self.cluster.raylets) <= 1:
-                return          # nowhere to spill to
-            for tid in list(self._local_queue):
-                t0 = self._local_since.get(tid)
-                if t0 is None or now - t0 <= timeout or \
-                        tid in self._pull_pending:
-                    continue
-                self._local_queue.remove(tid)
-                self._local_since.pop(tid, None)
-                self._env_miss_since.pop(tid, None)
-                rec = self.task_manager.get(tid)
-                if rec is not None:
-                    self._planned_add(rec.spec.resources, -1)
-                # re-place AWAY from this starved node (reference:
-                # spillback excludes the rejecting raylet)
-                self._avoid_local.add(tid)
-                moved.append(tid)
+            if not multi_node:
+                pass            # nowhere to spill to; the pipelined-
+                # lease recall below still applies single-node
+            else:
+                self._spill_queue_locked(now, timeout, moved)
         for tid in moved:
             self._enqueue(tid)
+        # pipelined-lease staleness: a committed task stuck behind a
+        # long-running (but never-blocking) holder past the lease
+        # timeout pulls back and re-enters local dispatch
+        stale_workers = []
+        with self.pool._lock:
+            workers = list(self.pool._workers)
+        for w in workers:
+            with self._cv:
+                oldest = w.assigned[0][1] if w.assigned else None
+            if oldest is not None and now - oldest > timeout:
+                stale_workers.append(w)
+        for w in stale_workers:
+            # multi-node: spill away from this node like the queue path
+            # above; single-node there is nowhere else to go
+            self._recall_assigned(w, avoid_local=multi_node)
+
+    def _spill_queue_locked(self, now, timeout, moved) -> None:
+        """Move lease-timed-out queue entries into ``moved`` (caller
+        holds ``_cv`` and re-enqueues globally)."""
+        for tid in list(self._local_queue):
+            t0 = self._local_since.get(tid)
+            if t0 is None or now - t0 <= timeout or \
+                    tid in self._pull_pending:
+                continue
+            self._local_queue.remove(tid)
+            self._local_since.pop(tid, None)
+            self._env_miss_since.pop(tid, None)
+            rec = self.task_manager.get(tid)
+            if rec is not None:
+                self._planned_add(rec.spec.resources, -1)
+            # re-place AWAY from this starved node (reference:
+            # spillback excludes the rejecting raylet)
+            self._avoid_local.add(tid)
+            moved.append(tid)
+
+    def _reconcile_assigned(self) -> None:
+        """Safety net for pipelined-lease commit races: entries parked
+        on a worker that is dead, blocked, or idle (its release raced
+        the commit) have no result-arrival left to ship them — recall
+        so normal dispatch takes over.  Runs on the event loop's timed
+        wake while any entries exist."""
+        with self._cv:
+            if self._assigned_total <= 0:
+                return
+        with self.pool._lock:
+            workers = list(self.pool._workers)
+        for w in workers:
+            with self._cv:
+                if not w.assigned:
+                    continue
+            if w.dead:
+                self._recall_assigned(w, to_global=True)
+            elif w.blocked or w.leased_task is None:
+                self._recall_assigned(w)
+
+    def _dispatch_next_assigned(self, worker: WorkerHandle) -> bool:
+        """Send the next pipelined-lease task to a worker that just
+        finished one.  Returns True when an exec frame shipped; False
+        when the queue is empty (a failed dispatch recalls the
+        remainder — its failure path already released the worker, which
+        would otherwise strand them)."""
+        while True:
+            with self._cv:
+                if not worker.assigned:
+                    return False
+                task_id, _t = worker.assigned.popleft()
+                self._assigned_total -= 1
+            rec = self.task_manager.get(task_id)
+            if rec is None or rec.done:
+                # completed while queued = cancelled; the cancel path
+                # removed-or-refunded already (a refund HERE would
+                # double-credit the CRM — see _recall_assigned, which
+                # skips the same way)
+                continue
+            if self._dispatch(worker, rec):
+                return True
+            self._recall_assigned(worker)
+            return False
+
+    def _recall_assigned(self, worker: WorkerHandle,
+                         to_global: bool = False,
+                         avoid_local: bool = False) -> None:
+        """Pull every not-yet-sent task back off a worker (blocked in a
+        get, declared stale, or dying) and requeue it for dispatch
+        elsewhere.  Resources return; placement is re-planned.
+        ``avoid_local``: re-place AWAY from this node (stale-lease
+        spillback — local requeue would just re-commit to the same
+        wedged worker in a loop)."""
+        with self._cv:
+            spill = list(worker.assigned)
+            worker.assigned.clear()
+            self._assigned_total -= len(spill)
+        for task_id, _t in spill:
+            rec = self.task_manager.get(task_id)
+            if rec is None or rec.done:
+                continue        # cancelled while queued: the cancel
+                # path refunded at removal (see _dispatch_next_assigned)
+            self.crm.add_back(self.row, rec.spec.resources)
+            if avoid_local:
+                with self._cv:
+                    self._avoid_local.add(task_id)
+                self._enqueue(task_id)
+            elif to_global:
+                self._enqueue(task_id)
+            else:
+                with self._cv:
+                    self._local_queue.append(task_id)
+                    self._local_since[task_id] = time.monotonic()
+                    self._planned_add(rec.spec.resources, 1)
+        if spill:
+            self._notify_dirty()
 
     def _requeue_after_worker_loss(self, rec, worker: WorkerHandle) -> None:
         self.crm.add_back(self.row, rec.spec.resources)
@@ -911,7 +1062,10 @@ class Raylet:
                             self.store.put(oid, err)
                 self.task_manager.complete(task_id)
                 self.crm.add_back(self.row, rec.spec.resources)
-            self.pool.release(worker)
+            # pipelined lease: ship the next committed task from THIS
+            # reader thread before anything else can steal the worker
+            if not self._dispatch_next_assigned(worker):
+                self.pool.release(worker)
             self._notify_dirty()
         elif kind == "get":
             oids = [self._oid(b) for b in msg[1]]
@@ -1083,8 +1237,12 @@ class Raylet:
 
     def _enter_blocked(self, worker: WorkerHandle, rec) -> None:
         """Worker blocks in get/wait: return its task's resources so
-        dependent tasks can run, and grow the pool if starved."""
+        dependent tasks can run, and grow the pool if starved.  Tasks
+        pipelined behind the blocker are recalled and dispatch
+        elsewhere — left queued they could deadlock (the blocker may be
+        waiting on exactly the task parked behind it)."""
         worker.blocked = True
+        self._recall_assigned(worker)
         if rec is not None:
             self.crm.add_back(self.row, rec.spec.resources)
             self._notify_dirty()
@@ -1109,6 +1267,8 @@ class Raylet:
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
         self._drain_worker_pins(worker)
+        # not-yet-sent pipelined tasks were never at risk: requeue them
+        self._recall_assigned(worker, to_global=True)
 
         if self.actor_manager is not None and \
                 self.actor_manager.on_worker_death(worker):
@@ -1179,6 +1339,24 @@ class Raylet:
                 self._cancel_seal_and_complete(task_id)
                 return True
             entry = self._running.get(task_id.binary())
+        if entry is None:
+            # committed to a worker's pipelined lease but not yet sent:
+            # remove + refund; sealing completes the record so a racing
+            # _dispatch_next_assigned skips it
+            with self.pool._lock:
+                workers = list(self.pool._workers)
+            for w in workers:
+                with self._cv:
+                    match = [e for e in w.assigned if e[0] == task_id]
+                    for e in match:
+                        w.assigned.remove(e)
+                        self._assigned_total -= 1
+                if match:
+                    rec0 = self.task_manager.get(task_id)
+                    if rec0 is not None:
+                        self.crm.add_back(self.row, rec0.spec.resources)
+                    self._cancel_seal_and_complete(task_id)
+                    return True
         if entry is not None and force:
             self.pool.kill_worker(entry[1])  # death path does bookkeeping
             return True
@@ -1188,6 +1366,12 @@ class Raylet:
         """Node death: fail/retry running tasks, reroute queued ones,
         restart-or-fail actors placed here, keep dep-waiting tasks alive
         (their readiness callbacks re-route to the fallback raylet)."""
+        # recall never-sent pipelined tasks FIRST so the queue capture
+        # below reroutes them with everything else
+        with self.pool._lock:
+            pool_workers = list(self.pool._workers)
+        for w in pool_workers:
+            self._recall_assigned(w)
         with self._cv:
             self._stopped = True
             self._removal_fallback = fallback

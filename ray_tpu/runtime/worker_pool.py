@@ -15,6 +15,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import threading
+from collections import deque
 from typing import Callable
 
 from .worker import worker_main
@@ -38,12 +39,16 @@ class WorkerHandle:
         self.env_key = None                 # runtime-env cache key
         self.env_payload = None             # staged payload (respawn)
         self.leased_task = None             # task_id_bin while executing
+        # pipelined lease: (TaskID, assign_time) entries committed to
+        # this worker but NOT yet sent — recallable (blocked worker,
+        # stale lease, death) until the exec frame ships.  Mutated under
+        # the owning raylet's _cv.
+        self.assigned: deque = deque()
         self.fn_cache: set[str] = set()
         # FIFO of shm-pin batches for get replies in flight to this
         # worker; drained by its get_ack frames, or by death/drain
         # cleanup (which may run on another thread — hence the lock and
         # the no_more_pins latch that stops late appends).
-        from collections import deque
         self.pending_get_pins: deque = deque()
         self.pin_lock = threading.Lock()
         self.no_more_pins = False
@@ -246,6 +251,25 @@ class WorkerPool:
                     del self._idle[i]
                     return h
             return None
+
+    def pipeline_target(self, env_key=None,
+                        depth: int = 2) -> WorkerHandle | None:
+        """A busy (executing, not blocked, not dedicated) worker with
+        room in its pipelined-lease queue, matching ``env_key`` —
+        least-loaded first.  ``assigned`` lengths are read without the
+        raylet lock (heuristic tie-break only; the raylet re-checks
+        under its own lock when committing)."""
+        with self._cv:
+            best = None
+            for h in self._workers:
+                if h.dead or h.dedicated or h.blocked or \
+                        h.env_key != env_key or h.leased_task is None:
+                    continue
+                if len(h.assigned) >= depth - 1:
+                    continue
+                if best is None or len(h.assigned) < len(best.assigned):
+                    best = h
+            return best
 
     def release(self, handle: WorkerHandle) -> None:
         with self._cv:
