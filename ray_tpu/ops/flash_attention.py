@@ -1,0 +1,128 @@
+"""Flash attention as a Pallas TPU kernel — the single-device hot op.
+
+Blocked attention with the online-softmax recurrence computed entirely
+in VMEM: for each query block the kernel streams key/value blocks,
+keeps the running (max, normalizer, accumulator) as loop carries, and
+writes one normalized output block — O(T) memory instead of the O(T^2)
+score matrix, with both matmuls on the MXU
+(``preferred_element_type=float32`` accumulation).
+
+Pairs with the mesh-level strategies in ``ops/ring_attention.py``: ring
+/ Ulysses shard the sequence ACROSS chips; this kernel is the
+within-chip block engine.  On non-TPU backends it runs in Pallas
+interpreter mode (tests on the CPU mesh), so one code path serves both.
+
+Shapes ``(batch, seq, heads, dim)``; ``seq`` must divide by the block
+size and ``dim`` should be a multiple of 128 (MXU lane width) for the
+compiled path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """softmax(QK^T / sqrt(d)) V, blockwise in VMEM.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError("q/k/v must share shape (batch, seq, heads, "
+                         f"dim); got {q.shape}/{k.shape}/{v.shape}")
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"seq {t} must divide by block_q={block_q} and "
+            f"block_k={block_k} (pad the sequence)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / np.sqrt(d)
+    nq, nk = t // block_q, t // block_k
+
+    # the key-block index is a GRID dimension (innermost = sequential
+    # on TPU), with the online-softmax state in VMEM scratch persisting
+    # across its steps: VMEM holds O(block) of K/V at a time, so the
+    # sequence length is bounded by HBM, not by the ~16 MB VMEM (the
+    # regime flash attention exists for).  m/l ride (block_q, 128)
+    # scratch — lane-width tiles; column 0 is the value.
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        qi, j = pl.program_id(1), pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        # causal: key blocks entirely past this query block are dead
+        # work — skip the matmuls, not just the probabilities
+        live = (j * block_k <= qi * block_q + block_q - 1) \
+            if causal else (j >= 0)
+
+        @pl.when(live)
+        def _accumulate():
+            qb = q_ref[0].astype(jnp.float32) * scale   # (BQ, D)
+            kb = k_ref[0].astype(jnp.float32)           # (BK, D)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (BQ, BK)
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(k_pos > q_pos, -jnp.inf, s)
+            m_prev = m_ref[:, :1]                       # (BQ, 1)
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            vb = v_ref[0].astype(jnp.float32)
+            acc_ref[...] = acc_ref[...] * corr + jax.lax.dot(
+                p, vb, preferred_element_type=jnp.float32)
+            l_new = l_ref[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(j == nk - 1)
+        def _finalize():
+            o_ref[0] = (acc_ref[...] /
+                        jnp.maximum(l_ref[:, :1], 1e-30)) \
+                .astype(o_ref.dtype)
+
+    # heads fold into the grid's leading axis: (B*H, T, D) layout
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(fold(q), fold(k), fold(v))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
