@@ -36,16 +36,19 @@ class ActorMethod:
                            else self._num_returns)
 
     def remote(self, *args, **kwargs):
+        from .util.tracing import context_for_new_task
         rt = _runtime()
         actor_id = self._handle._actor_id
         job_id = actor_id.job_id()
         task_id = TaskID.for_task(job_id, actor_id)
+        trace_ctx = context_for_new_task(task_id)
         if rt.is_driver:
             rt.actor_manager.submit(actor_id, task_id, self._name, args,
-                                    kwargs, self._num_returns)
+                                    kwargs, self._num_returns,
+                                    trace_ctx=trace_ctx)
         else:
             rt.submit_actor_call(actor_id, task_id, self._name, args,
-                                 kwargs, self._num_returns)
+                                 kwargs, self._num_returns, trace_ctx)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
                 for i in range(self._num_returns)]
         return refs[0] if self._num_returns == 1 else refs

@@ -262,14 +262,16 @@ class RemoteFunction:
             res = shape_request(res,
                                 self._strategy.placement_group_id.hex(),
                                 self._strategy.bundle_index)
+        from .util.tracing import context_for_new_task
         spec = TaskSpec(
             task_id=task_id, job_id=job_id, task_type=TaskType.NORMAL_TASK,
             function_descriptor=fn_id, args=args, kwargs=kwargs,
             num_returns=self._num_returns,
             resources=ResourceRequest(res),
             strategy=self._strategy, max_retries=retries,
-            runtime_env=self._runtime_env)  # the job-level env merges in
-        #                                     at the raylet submit intake
+            runtime_env=self._runtime_env,  # the job-level env merges in
+            #                                 at the raylet submit intake
+            trace_ctx=context_for_new_task(task_id))
         # result refs are created BEFORE submission: the owner's refcount
         # must never dip to zero while the caller is still building them
         from .common.ids import ObjectID

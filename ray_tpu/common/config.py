@@ -124,6 +124,12 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "task_max_retries_default": (
         int, 3,
         "Default max_retries for tasks (reference default: 3)."),
+    "tracing_enabled": (
+        bool, False,
+        "Propagate trace context through task specs and tag timeline "
+        "spans with (trace_id, parent_span) so a request's task tree "
+        "is reconstructable (reference: RAY_TRACING_ENABLED + "
+        "OpenTelemetry context propagation)."),
     "health_check_period_ms": (int, 1000, "GCS -> raylet ping period."),
     "health_check_failure_threshold": (
         int, 5, "Missed pings before a node is declared dead."),

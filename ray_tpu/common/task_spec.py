@@ -79,6 +79,10 @@ class TaskSpec:
     actor_id: ActorID | None = None   # set for actor creation/actor tasks
     # per-task runtime environment (env_vars/working_dir/py_modules/pip)
     runtime_env: dict | None = None
+    # tracing: (trace_id, parent_span_id) propagated caller -> task
+    # when ``tracing_enabled`` (reference: OpenTelemetry context in
+    # task specs behind RAY_TRACING_ENABLED)
+    trace_ctx: tuple | None = None
     # lineage: object deps this spec needs (resolved by DependencyManager)
     dependencies: tuple = ()
     # retry bookkeeping (mutated by TaskManager)

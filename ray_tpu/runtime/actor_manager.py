@@ -51,6 +51,8 @@ class ActorCall:
     kwargs: dict
     num_returns: int
     retries_left: int = 0
+    trace_ctx: tuple | None = None      # (trace_id, parent_span)
+    sent_at: float = 0.0                # span start (set at send)
 
 
 @dataclass
@@ -239,14 +241,16 @@ class ActorManager:
 
     # -- method submission --------------------------------------------------
     def submit(self, actor_id: ActorID, task_id: TaskID, method: str,
-               args: tuple, kwargs: dict, num_returns: int) -> None:
+               args: tuple, kwargs: dict, num_returns: int,
+               trace_ctx: tuple | None = None) -> None:
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None or rec.state is ActorState.DEAD:
                 self._fail_call_ids(task_id, num_returns, actor_id)
                 return
             call = ActorCall(task_id, method, args, kwargs, num_returns,
-                             retries_left=rec.max_task_retries)
+                             retries_left=rec.max_task_retries,
+                             trace_ctx=trace_ctx)
             rec.queue.append(call)
         self._pump(actor_id)
 
@@ -301,8 +305,10 @@ class ActorManager:
                             dep_err)
                     continue
                 rec.inflight[call.task_id.binary()] = call
+                import time as _time
+                call.sent_at = _time.time()
                 payload = serialize((tuple(vals), call.kwargs,
-                                     call.num_returns))
+                                     call.num_returns, call.trace_ctx))
                 rec.worker.send(("actor_call", call.task_id.binary(),
                                  call.method, payload))
         # head has missing deps: wake the pump when they land
@@ -343,6 +349,14 @@ class ActorManager:
                 call = rec.inflight.pop(task_id_bin, None) if rec else None
             if call is None:
                 return True
+            if call.trace_ctx is not None:
+                import time as _time
+                self._cluster.events.span(
+                    "actor_task", call.method[:24], call.sent_at,
+                    _time.time(), rec.row if rec is not None else -1,
+                    status=kind, trace_id=call.trace_ctx[0],
+                    parent_id=call.trace_ctx[1],
+                    span_id=call.task_id.hex())
             if kind == "actor_result":
                 row = rec.row if rec is not None else -1
                 for i, data in enumerate(msg[2]):

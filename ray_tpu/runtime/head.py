@@ -150,10 +150,10 @@ class HeadNode:
                            num_returns: int) -> None:
         from .object_ref import counter_suppressed
         with counter_suppressed():      # see _submit_spec
-            args, kwargs = deserialize(payload)
+            args, kwargs, trace_ctx = deserialize(payload)
         self._rt.actor_manager.submit(
             ActorID(actor_bin), TaskID(task_bin), method, args, kwargs,
-            num_returns)
+            num_returns, trace_ctx=trace_ctx)
 
     def _kill_actor(self, actor_bin: bytes, no_restart: bool) -> None:
         self._rt.actor_manager.kill(ActorID(actor_bin),

@@ -725,7 +725,8 @@ class Raylet:
         with self._cv:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
                                                     pinned)
-        if not worker.send(("exec", spec.task_id.binary(), fn_id, payload)):
+        if not worker.send(("exec", spec.task_id.binary(), fn_id, payload,
+                            spec.trace_ctx)):
             with self._cv:
                 entry = self._running.pop(spec.task_id.binary(), None)
             if entry is not None:
@@ -1018,9 +1019,9 @@ class Raylet:
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
-                args, kwargs, num_returns = deserialize(msg[4])
+                args, kwargs, num_returns, trace_ctx = deserialize(msg[4])
                 am.submit(ActorID(msg[1]), TaskID(msg[2]), msg[3], args,
-                          kwargs, num_returns)
+                          kwargs, num_returns, trace_ctx=trace_ctx)
                 return
             if kind == "actor_kill":
                 from ..common.ids import ActorID
@@ -1043,10 +1044,15 @@ class Raylet:
             rec = self.task_manager.get(task_id)
             t0 = self._task_start.pop(task_id_bin, None)
             if t0 is not None and rec is not None:
+                trace = {}
+                if rec.spec.trace_ctx is not None:
+                    trace = {"trace_id": rec.spec.trace_ctx[0],
+                             "parent_id": rec.spec.trace_ctx[1],
+                             "span_id": rec.spec.task_id.hex()}
                 self.cluster.events.span(
                     "task", rec.spec.function_descriptor[:16], t0,
                     time.time(), self.row, worker=worker.proc.pid,
-                    status=kind)
+                    status=kind, **trace)
             if rec is not None and not rec.done:
                 # returns seal BEFORE complete(): a dropped ref whose
                 # decref folds mid-handler must see either a pending

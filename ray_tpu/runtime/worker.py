@@ -12,7 +12,7 @@ send/recv pairs are race-free without correlation ids.
 
 Frames (tuples, first element is the kind):
   raylet -> worker: ("fn", fn_id, bytes), ("exec", task_id_bin, fn_id,
-                    payload), ("get_reply", payload),
+                    payload, trace_ctx), ("get_reply", payload),
                     ("wait_reply", payload), ("shutdown",)
   worker -> raylet: ("ready",), ("result", task_id_bin, [bytes, ...]),
                     ("error", task_id_bin, bytes), ("get", [oid_bin, ...]),
@@ -170,10 +170,12 @@ class WorkerApiContext:
         self._conn.send(("pg_remove", pg_id.binary()))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
-                          kwargs, num_returns: int):
+                          kwargs, num_returns: int,
+                          trace_ctx: tuple | None = None):
         self._conn.send(("actor_submit", actor_id.binary(),
                          task_id.binary(), method,
-                         serialize((args, kwargs, num_returns))))
+                         serialize((args, kwargs, num_returns,
+                                    trace_ctx))))
 
     def kill_actor(self, actor_id, no_restart: bool = True):
         self._conn.send(("actor_kill", actor_id.binary(), no_restart))
@@ -218,13 +220,21 @@ def worker_main(conn, worker_index: int,
         if kind == "fn":
             fn_table[msg[1]] = deserialize(msg[2])
         elif kind == "exec":
-            _, task_id_bin, fn_id, payload = msg
+            _, task_id_bin, fn_id, payload, trace_ctx = msg
             args, kwargs, num_returns = deserialize(payload)
             args = tuple(ctx._materialize(a.desc) if isinstance(a, ArgRef)
                          else a for a in args)
             fn = fn_table[fn_id]
             name = getattr(fn, "__qualname__", str(fn))
             ctx.begin_task(TaskID(task_id_bin))
+            if trace_ctx is not None:
+                # this task's span becomes the ambient scope, so specs
+                # it submits inherit (trace_id, THIS span) as context
+                from ..util.tracing import span_scope
+                _scope = span_scope(trace_ctx[0], TaskID(task_id_bin).hex())
+                _scope.__enter__()
+            else:
+                _scope = None
             try:
                 out = fn(*args, **kwargs)
                 if num_returns == 1:
@@ -248,6 +258,8 @@ def worker_main(conn, worker_index: int,
                     conn.send(("error", task_id_bin, serialize(
                         RayTaskError(name, err.tb, None))))
             finally:
+                if _scope is not None:
+                    _scope.__exit__(None, None, None)
                 ctx.end_task()
         elif kind == "actor_new":
             _, actor_id_bin, cls_id, payload = msg
@@ -267,12 +279,19 @@ def worker_main(conn, worker_index: int,
                 ctx.end_task()
         elif kind == "actor_call":
             _, task_id_bin, method, payload = msg
-            args, kwargs, num_returns = deserialize(payload)
+            args, kwargs, num_returns, trace_ctx = deserialize(payload)
             if method == "__ray_terminate__":
                 conn.send(("actor_exit", actor_id_bin))
                 conn.send(("actor_result", task_id_bin, [serialize(None)]))
                 break
             ctx.begin_task(TaskID(task_id_bin))
+            if trace_ctx is not None:
+                # tasks the actor method submits link under this call
+                from ..util.tracing import span_scope
+                _scope = span_scope(trace_ctx[0], TaskID(task_id_bin).hex())
+                _scope.__enter__()
+            else:
+                _scope = None
             try:
                 bound = getattr(actor_instance, method)
                 out = bound(*args, **kwargs)
@@ -293,6 +312,8 @@ def worker_main(conn, worker_index: int,
                 conn.send(("actor_error", task_id_bin, serialize(
                     RayTaskError.from_exception(method, e))))
             finally:
+                if _scope is not None:
+                    _scope.__exit__(None, None, None)
                 ctx.end_task()
         elif kind == "shutdown":
             break

@@ -94,10 +94,11 @@ class ClientRuntime:
                               strategy, runtime_env)))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
-                          kwargs, num_returns: int) -> None:
+                          kwargs, num_returns: int,
+                          trace_ctx: tuple | None = None) -> None:
         self._call("submit_actor_call", actor_id.binary(),
-                   task_id.binary(), method, serialize((args, kwargs)),
-                   num_returns)
+                   task_id.binary(), method,
+                   serialize((args, kwargs, trace_ctx)), num_returns)
 
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         self._call("kill_actor", actor_id.binary(), no_restart)
