@@ -1,0 +1,37 @@
+"""Native arena allocator: concurrency stress via the sanitizer harness.
+
+Scenario sources: upstream CI runs C++ tests under ASAN/TSAN bazel
+configs (SURVEY.md §4 sanitizers row, §5.2); the plain build runs in
+the suite, the asan/tsan targets run under the slow marker
+(``make -C ray_tpu/native sanitize``)."""
+
+import os
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ray_tpu", "native")
+
+
+def _make(target: str, timeout: float):
+    return subprocess.run(["make", "-C", NATIVE, target],
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+class TestArenaStress:
+    def test_stress_clean(self):
+        r = _make("stress", 120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "ARENA STRESS PASSED" in r.stdout
+        assert "corruptions=0" in r.stdout
+        assert "leaked=0" in r.stdout
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("target", ["asan", "tsan"])
+    def test_sanitizers_clean(self, target):
+        r = _make(target, 600)
+        assert r.returncode == 0, \
+            f"{target}: {r.stdout[-2000:]}{r.stderr[-2000:]}"
+        assert "ARENA STRESS PASSED" in r.stdout
