@@ -1,8 +1,13 @@
 from .bundle_kernel import schedule_bundle_groups, schedule_bundle_groups_np
+from .flash_attention import flash_attention
 from .hybrid_kernel import schedule_grouped, schedule_grouped_np
 from .pull_kernel import (choose_sources, choose_sources_np,
                           choose_sources_oracle)
+from .ring_attention import (full_attention, ring_attention,
+                             ulysses_attention)
 
 __all__ = ["schedule_bundle_groups", "schedule_bundle_groups_np",
            "schedule_grouped", "schedule_grouped_np",
-           "choose_sources", "choose_sources_np", "choose_sources_oracle"]
+           "choose_sources", "choose_sources_np", "choose_sources_oracle",
+           "flash_attention", "full_attention", "ring_attention",
+           "ulysses_attention"]
