@@ -69,6 +69,14 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Default is the break-even for a TUNNELED dev chip (~90 ms/call "
         "vs ~25 us/placement on CPU); drop to a few hundred when the TPU "
         "is host-local."),
+    "scheduler_sharded_state": (
+        bool, False,
+        "Shard the device scheduler's cluster-state rows over ALL local "
+        "devices (jax Mesh on a 'nodes' axis): each device owns N/n_dev "
+        "node rows and the water-fill's global reductions lower to XLA "
+        "collectives over ICI.  Off (default) keeps single-device "
+        "arrays — correct either way (dryrun-proven bit-equality); on "
+        "one chip there is nothing to shard."),
     # -- object store -------------------------------------------------------
     "object_store_memory_mb": (
         int, 512,

@@ -408,11 +408,18 @@ class Raylet:
         req_arr[:G] = np.stack(reqs)
         cnt_arr = np.zeros(Gp, dtype=np.int32)
         cnt_arr[:G] = counts
-        counts_dev, _ = schedule_grouped(
-            jnp.asarray(totals), jnp.asarray(avail), jnp.asarray(mask),
-            jnp.asarray(req_arr), jnp.asarray(cnt_arr),
-            jnp.ones((Gp, N), dtype=bool), jnp.int32(threshold_fp(None)))
-        counts_host = np.asarray(counts_dev)[:G]
+        if get_config().scheduler_sharded_state:
+            # host gmask: the sharded branch pads its node axis
+            counts_host = self._schedule_sharded(
+                totals, avail, mask, req_arr, cnt_arr,
+                np.ones((Gp, N), dtype=bool))[:G]
+        else:
+            counts_dev, _ = schedule_grouped(
+                jnp.asarray(totals), jnp.asarray(avail),
+                jnp.asarray(mask), jnp.asarray(req_arr),
+                jnp.asarray(cnt_arr), jnp.ones((Gp, N), dtype=bool),
+                jnp.int32(threshold_fp(None)))
+            counts_host = np.asarray(counts_dev)[:G]
         # expand (G, N+1) counts into per-task rows, class-internal order
         # node-row-ascending (tasks within a class are interchangeable)
         slots = [np.repeat(
@@ -426,6 +433,67 @@ class Raylet:
             rows.append(int(slots[g][cursor[g]]))
             cursor[g] += 1
         return rows
+
+    def _schedule_sharded(self, totals, avail, mask, req_arr, cnt_arr,
+                          gmask) -> "np.ndarray":
+        """The device placement call with cluster-state rows SHARDED
+        over all local devices (the live-path form of the multi-chip
+        layout ``__graft_entry__.dryrun_multichip`` proves): each device
+        owns N/n_dev node rows; the water-fill's global sums lower to
+        all-reduces over ICI.  Node rows pad to a mesh multiple with
+        mask-False rows (no-ops in the kernel).  Returns host counts of
+        shape (Gp, N+1) — real node columns plus the infeasible
+        column."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops import schedule_grouped
+        from ..scheduling.contract import threshold_fp
+        # local_devices, NOT devices(): in multi-process JAX the global
+        # list includes non-addressable chips, and device_put of host
+        # arrays onto those raises
+        devs = jax.local_devices()
+        n_dev = len(devs)
+        n = totals.shape[0]
+        pad = (-n) % n_dev
+        if pad:
+            totals = np.pad(totals, ((0, pad), (0, 0)))
+            avail = np.pad(avail, ((0, pad), (0, 0)))
+            mask = np.pad(mask, (0, pad))               # padding: dead rows
+            gmask = np.pad(gmask, ((0, 0), (0, pad)))
+        cache = getattr(self, "_shard_cache", None)
+        if cache is None or cache[0] != n_dev:
+            mesh = Mesh(np.array(devs), ("nodes",))
+            shardings = {
+                "rows": NamedSharding(mesh, P("nodes", None)),
+                "vec": NamedSharding(mesh, P("nodes")),
+                "repl": NamedSharding(mesh, P()),
+                "gn": NamedSharding(mesh, P(None, "nodes")),
+            }
+            step = jax.jit(
+                schedule_grouped,
+                out_shardings=(shardings["repl"], shardings["rows"]))
+            self._shard_cache = (n_dev, shardings, step)
+        _, sh, step = self._shard_cache
+        # device_put takes host numpy + sharding directly: ONE sharded
+        # transfer per array (a jnp.asarray first would materialize on
+        # the default device and reshard — double transfer)
+        counts_dev, _ = step(
+            jax.device_put(totals, sh["rows"]),
+            jax.device_put(avail, sh["rows"]),
+            jax.device_put(mask, sh["vec"]),
+            jax.device_put(req_arr, sh["repl"]),
+            jax.device_put(cnt_arr, sh["repl"]),
+            jax.device_put(gmask, sh["gn"]),
+            jnp.int32(threshold_fp(None)))
+        counts = np.asarray(counts_dev)
+        if pad:
+            # drop padding-node columns; the infeasible column is last
+            counts = np.concatenate([counts[:, :n], counts[:, -1:]],
+                                    axis=1)
+        return counts
 
     def _effective_snapshot(self):
         """CRM snapshot minus every node's planned-but-undispatched load,

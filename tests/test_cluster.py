@@ -100,6 +100,61 @@ class TestMultiNode:
         finally:
             cfg.scheduler_device_batch_min = old
 
+    def test_sharded_state_live_path(self, cluster):
+        """The live scheduler with cluster-state rows sharded over the
+        8-device virtual mesh (scheduler_sharded_state): placements
+        run as one sharded XLA program and every task completes."""
+        from ray_tpu.common.config import Config
+        cfg = Config.instance()
+        old_min = cfg.scheduler_device_batch_min
+        cfg.scheduler_device_batch_min = 8
+        cfg.scheduler_sharded_state = True
+        try:
+            refs = [padded.remote(i) for i in range(64)]
+            assert ray_tpu.get(refs, timeout=60) == \
+                [i + 1 for i in range(64)]
+        finally:
+            cfg.scheduler_device_batch_min = old_min
+            cfg.scheduler_sharded_state = False
+
+
+class TestShardedKernelParity:
+    def test_sharded_counts_match_single_device(self):
+        """The sharded layout (rows over the mesh, pad rows masked off)
+        returns bit-identical counts to the single-device call — the
+        live-path analogue of dryrun_multichip's oracle check, with a
+        node count that does NOT divide the mesh (pad rows exercised)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.ops import schedule_grouped
+        from ray_tpu.runtime.raylet import Raylet
+        from ray_tpu.scheduling.contract import threshold_fp
+
+        rng = np.random.default_rng(0)
+        n, width, gp = 27, 4, 8           # 27 % 8 devices != 0
+        totals = rng.integers(400, 6400, size=(n, width)).astype(np.int32)
+        avail = (totals * rng.random((n, width))).astype(np.int32)
+        mask = np.ones(n, dtype=bool)
+        req = rng.integers(0, 300, size=(gp, width)).astype(np.int32)
+        cnt = rng.integers(0, 50, size=gp).astype(np.int32)
+        gmask = np.ones((gp, n), dtype=bool)
+
+        single, _ = schedule_grouped(
+            jnp.asarray(totals), jnp.asarray(avail), jnp.asarray(mask),
+            jnp.asarray(req), jnp.asarray(cnt), jnp.asarray(gmask),
+            jnp.int32(threshold_fp(None)))
+
+        shim = object.__new__(Raylet)     # only _schedule_sharded runs
+        sharded = Raylet._schedule_sharded(
+            shim, totals, avail, mask, req, cnt, gmask)
+        np.testing.assert_array_equal(np.asarray(single),
+                                      np.asarray(sharded))
+        # the mesh must really have been multi-shard for this to prove
+        # anything (exact count depends on the backend)
+        assert len(jax.local_devices()) >= 2
+
 
 class TestNodeArrival:
     def test_add_node_wakes_parked_infeasible_tasks(self):
