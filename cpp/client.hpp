@@ -1,0 +1,274 @@
+// C++ client frontend for ray_tpu (the reference's `cpp/` analogue).
+//
+// Reference parity: the reference ships a standalone C++ API (`cpp/`:
+// `ray::Init`, `ray::Task(...).Remote()`, `ray::Get`, actor handles —
+// SURVEY.md §1 layer 8, §2.1; mount empty).  This client speaks the head
+// daemon's cross-language gateway (ray_tpu/rpc/xlang_gateway.py): frames
+// are `u32 length + xlang value`; requests `[req_id, method, args]`,
+// replies `[req_id, ok, payload]`, error payloads `[exc_type, message]`.
+// Functions and actor classes are addressed by their cross-language
+// export name (ray_tpu/cross_language.py).
+//
+//   raytpu::Client client("127.0.0.1:6184");
+//   auto ref = client.Call("add", {Value::Int(1), Value::Int(2)})[0];
+//   int64_t three = client.Get(ref).AsInt();
+//
+// Synchronous, one request in flight per client (guarded by a mutex);
+// open several clients for concurrency — each gateway connection serves
+// pipelined requests on server-side threads.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "xlang.hpp"
+
+namespace raytpu {
+
+// A handler on the head raised: carries the Python exception type name.
+class RemoteError : public std::runtime_error {
+ public:
+  RemoteError(std::string type, const std::string& message)
+      : std::runtime_error(type + ": " + message),
+        type_(std::move(type)) {}
+  const std::string& type() const { return type_; }
+
+ private:
+  std::string type_;
+};
+
+struct ObjectRef {
+  std::string id;  // raw object-id bytes (opaque to the client)
+};
+
+class Client;
+
+struct ActorHandle {
+  std::string id;  // raw actor-id bytes
+  Client* client = nullptr;
+
+  std::vector<ObjectRef> Call(const std::string& method, ValueList args,
+                              int num_returns = 1);
+  void Kill(bool no_restart = true);
+};
+
+class Client {
+ public:
+  explicit Client(const std::string& address) {
+    auto colon = address.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("address must be host:port");
+    Connect(address.substr(0, colon), address.substr(colon + 1));
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // -- core RPC -----------------------------------------------------------
+  Value Rpc(const std::string& method, ValueList args) {
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t req_id = next_id_++;
+    Value request = Value::List(
+        {Value::Int(req_id), Value::Str(method),
+         Value::List(std::move(args))});
+    SendFrame(request.Encode());
+    // one request in flight under mu_, so the next reply is ours; check
+    // the id anyway — a mismatch means a protocol bug, not a stray frame
+    Value reply = Value::DecodeAll(RecvFrame());
+    const ValueList& parts = reply.AsList();
+    if (parts.size() != 3 || parts[0].AsInt() != req_id)
+      throw std::runtime_error("xlang: reply does not match request");
+    if (parts[1].AsBool()) return parts[2];
+    const ValueList& err = parts[2].AsList();
+    throw RemoteError(err.at(0).AsStr(), err.at(1).AsStr());
+  }
+
+  // -- object API ---------------------------------------------------------
+  ObjectRef Put(const Value& value) {
+    return ObjectRef{Rpc("put", {value}).AsBytes()};
+  }
+
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs,
+                         double timeout_s = -1) {
+    ValueList ids;
+    ids.reserve(refs.size());
+    for (const auto& r : refs) ids.push_back(Value::Bytes(r.id));
+    Value out = Rpc("get", {Value::List(std::move(ids)),
+                            TimeoutValue(timeout_s)});
+    return out.AsList();
+  }
+
+  Value Get(const ObjectRef& ref, double timeout_s = -1) {
+    return Get(std::vector<ObjectRef>{ref}, timeout_s).at(0);
+  }
+
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
+      const std::vector<ObjectRef>& refs, int num_returns = 1,
+      double timeout_s = -1) {
+    ValueList ids;
+    ids.reserve(refs.size());
+    for (const auto& r : refs) ids.push_back(Value::Bytes(r.id));
+    Value out = Rpc("wait", {Value::List(std::move(ids)),
+                             Value::Int(num_returns),
+                             TimeoutValue(timeout_s)});
+    const ValueList& pair = out.AsList();
+    return {RefList(pair.at(0)), RefList(pair.at(1))};
+  }
+
+  // -- task API -----------------------------------------------------------
+  // opts: optional map {num_returns, num_cpus, resources, max_retries}
+  std::vector<ObjectRef> Call(const std::string& exported_name,
+                              ValueList args,
+                              Value opts = Value::Nil()) {
+    Value out = Rpc("call", {Value::Str(exported_name),
+                             Value::List(std::move(args)),
+                             std::move(opts)});
+    return RefList(out);
+  }
+
+  // -- actor API ----------------------------------------------------------
+  ActorHandle CreateActor(const std::string& exported_name, ValueList args,
+                          Value opts = Value::Nil()) {
+    Value out = Rpc("create_actor", {Value::Str(exported_name),
+                                     Value::List(std::move(args)),
+                                     std::move(opts)});
+    return ActorHandle{out.AsBytes(), this};
+  }
+
+  std::vector<ObjectRef> ActorCall(const ActorHandle& actor,
+                                   const std::string& method,
+                                   ValueList args, int num_returns = 1) {
+    Value out = Rpc("actor_call", {Value::Bytes(actor.id),
+                                   Value::Str(method),
+                                   Value::List(std::move(args)),
+                                   Value::Int(num_returns)});
+    return RefList(out);
+  }
+
+  void KillActor(const ActorHandle& actor, bool no_restart = true) {
+    Rpc("kill_actor", {Value::Bytes(actor.id), Value::Bool(no_restart)});
+  }
+
+  // -- introspection ------------------------------------------------------
+  Value Ping() { return Rpc("ping", {}); }
+  Value ClusterResources() { return Rpc("cluster_resources", {}); }
+  Value AvailableResources() { return Rpc("available_resources", {}); }
+  std::vector<std::string> Exports() {
+    Value out = Rpc("exports", {});
+    std::vector<std::string> names;
+    for (const auto& v : out.AsList()) names.push_back(v.AsStr());
+    return names;
+  }
+
+ private:
+  static Value TimeoutValue(double timeout_s) {
+    return timeout_s < 0 ? Value::Nil() : Value::Float(timeout_s);
+  }
+
+  static std::vector<ObjectRef> RefList(const Value& v) {
+    std::vector<ObjectRef> refs;
+    for (const auto& item : v.AsList())
+      refs.push_back(ObjectRef{item.AsBytes()});
+    return refs;
+  }
+
+  void Connect(const std::string& host, const std::string& port) {
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    int rc = ::getaddrinfo(host.c_str(), port.c_str(), &hints, &res);
+    if (rc != 0)
+      throw std::runtime_error(std::string("getaddrinfo: ") +
+                               ::gai_strerror(rc));
+    int fd = -1;
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd < 0)
+      throw std::runtime_error("cannot connect to " + host + ":" + port);
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, 1 /* TCP_NODELAY */, &one, sizeof(one));
+    fd_ = fd;
+  }
+
+  void SendFrame(const std::string& payload) {
+    // mirrors the server's MAX_FRAME sanity bound (rpc/wire.py); also
+    // rules out u32 length truncation for >4 GiB payloads — a wrapped
+    // header would corrupt the stream with no useful client error
+    static constexpr size_t kMaxFrame = 512ull * 1024 * 1024;
+    if (payload.size() > kMaxFrame)
+      throw std::runtime_error("xlang: frame exceeds 512 MiB bound");
+    char header[4] = {
+        static_cast<char>(payload.size() >> 24),
+        static_cast<char>(payload.size() >> 16),
+        static_cast<char>(payload.size() >> 8),
+        static_cast<char>(payload.size())};
+    SendAll(header, 4);
+    SendAll(payload.data(), payload.size());
+  }
+
+  std::string RecvFrame() {
+    char header[4];
+    RecvAll(header, 4);
+    uint32_t n = 0;
+    for (int i = 0; i < 4; ++i)
+      n = (n << 8) | static_cast<uint8_t>(header[i]);
+    std::string payload(n, '\0');
+    if (n > 0) RecvAll(&payload[0], n);
+    return payload;
+  }
+
+  void SendAll(const char* data, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t rc = ::send(fd_, data + sent, n - sent, 0);
+      if (rc <= 0) throw std::runtime_error("connection lost (send)");
+      sent += static_cast<size_t>(rc);
+    }
+  }
+
+  void RecvAll(char* data, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t rc = ::recv(fd_, data + got, n - got, 0);
+      if (rc <= 0) throw std::runtime_error("connection lost (recv)");
+      got += static_cast<size_t>(rc);
+    }
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+  int64_t next_id_ = 0;
+};
+
+inline std::vector<ObjectRef> ActorHandle::Call(const std::string& method,
+                                                ValueList args,
+                                                int num_returns) {
+  return client->ActorCall(*this, method, std::move(args), num_returns);
+}
+
+inline void ActorHandle::Kill(bool no_restart) {
+  client->KillActor(*this, no_restart);
+}
+
+}  // namespace raytpu

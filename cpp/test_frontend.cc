@@ -1,0 +1,168 @@
+// End-to-end exercise of the C++ frontend against a live head gateway.
+//
+// Built and driven by tests/test_cpp_frontend.py: argv[1] is the
+// gateway's host:port; the Python side exported the functions/actors
+// used here.  Prints CPP_FRONTEND_OK and exits 0 on success; any failed
+// check exits 1 with a message.
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "client.hpp"
+
+using raytpu::ActorHandle;
+using raytpu::Client;
+using raytpu::ObjectRef;
+using raytpu::RemoteError;
+using raytpu::Value;
+using raytpu::ValueList;
+using raytpu::ValueMap;
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,  \
+                   __LINE__, #cond);                                 \
+      std::exit(1);                                                  \
+    }                                                                \
+  } while (0)
+
+static void TestCodecLocal() {
+  // encode→decode identity for every kind, nested
+  Value v = Value::Map(ValueMap{
+      {Value::Str("ints"), Value::List({Value::Int(-1), Value::Int(1)})},
+      {Value::Str("pi"), Value::Float(3.25)},
+      {Value::Str("raw"), Value::Bytes(std::string("\x00\xff\x7f", 3))},
+      {Value::Str("uni"), Value::Str("héllo ✓")},
+      {Value::Int(7), Value::Bool(true)},
+      {Value::Str("none"), Value::Nil()},
+  });
+  CHECK(Value::DecodeAll(v.Encode()) == v);
+}
+
+static void TestPutGet(Client& client) {
+  Value payload = Value::Map(ValueMap{
+      {Value::Str("xs"), Value::List({Value::Int(1), Value::Float(2.5),
+                                      Value::Str("three"), Value::Nil(),
+                                      Value::Bool(false)})},
+      {Value::Str("blob"), Value::Bytes(std::string(1024, '\x42'))},
+  });
+  ObjectRef ref = client.Put(payload);
+  Value back = client.Get(ref, 30);
+  CHECK(back == payload);
+}
+
+static void TestCalls(Client& client) {
+  // plain call through an exported remote function
+  auto refs = client.Call("xadd", {Value::Int(40), Value::Int(2)});
+  CHECK(refs.size() == 1);
+  CHECK(client.Get(refs[0], 30).AsInt() == 42);
+
+  // bytes + str args, str return
+  auto cat = client.Call(
+      "xconcat", {Value::Str("ab"), Value::Bytes("cd")});
+  CHECK(client.Get(cat[0], 30).AsStr() == "ab+cd");
+
+  // multiple returns
+  auto dm = client.Call("xdivmod", {Value::Int(17), Value::Int(5)},
+                        Value::Map(ValueMap{
+                            {Value::Str("num_returns"), Value::Int(2)}}));
+  CHECK(dm.size() == 2);
+  CHECK(client.Get(dm[0], 30).AsInt() == 3);
+  CHECK(client.Get(dm[1], 30).AsInt() == 2);
+
+  // an object put from C++ is a readable task argument by id on the
+  // Python side (args are values, not refs, on this surface — ship the
+  // id and let the task get() it)
+  ObjectRef data = client.Put(Value::Int(1000));
+  auto sum = client.Call("xget_plus",
+                         {Value::Bytes(data.id), Value::Int(1)});
+  CHECK(client.Get(sum[0], 30).AsInt() == 1001);
+}
+
+static void TestErrors(Client& client) {
+  // remote task raising → typed error on get
+  bool threw = false;
+  try {
+    client.Get(client.Call("xboom", {})[0], 30);
+  } catch (const RemoteError& e) {
+    threw = true;
+    CHECK(std::string(e.what()).find("boom") != std::string::npos);
+  }
+  CHECK(threw);
+
+  // return value outside the cross-language subset → encode error
+  threw = false;
+  try {
+    client.Get(client.Call("xopaque", {})[0], 30);
+  } catch (const RemoteError& e) {
+    threw = true;
+    CHECK(e.type() == "XlangEncodeError");
+  }
+  CHECK(threw);
+
+  // unknown export
+  threw = false;
+  try {
+    client.Call("no_such_export", {});
+  } catch (const RemoteError& e) {
+    threw = true;
+    CHECK(e.type() == "KeyError");
+  }
+  CHECK(threw);
+}
+
+static void TestWait(Client& client) {
+  auto ref = client.Call("xadd", {Value::Int(1), Value::Int(1)})[0];
+  client.Get(ref, 30);  // ensure completion
+  auto [ready, pending] = client.Wait({ref}, 1, 5);
+  CHECK(ready.size() == 1 && pending.empty());
+  CHECK(ready[0].id == ref.id);
+}
+
+static void TestActors(Client& client) {
+  ActorHandle counter = client.CreateActor(
+      "XCounter", {Value::Int(10)},
+      Value::Map(ValueMap{{Value::Str("name"), Value::Str("cpp_ctr")}}));
+  ObjectRef last;
+  for (int i = 0; i < 3; ++i) last = counter.Call("incr", {})[0];
+  CHECK(client.Get(last, 30).AsInt() == 13);
+  CHECK(client.Get(counter.Call("total", {})[0], 30).AsInt() == 13);
+  counter.Kill();
+}
+
+static void TestIntrospection(Client& client) {
+  Value pong = client.Ping();
+  const Value* ok = pong.Find("ok");
+  CHECK(ok != nullptr && ok->AsBool());
+  auto exports = client.Exports();
+  bool has_add = false;
+  for (const auto& name : exports) has_add |= (name == "xadd");
+  CHECK(has_add);
+  Value resources = client.ClusterResources();
+  CHECK(!resources.AsMap().empty());
+}
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s host:port\n", argv[0]);
+    return 2;
+  }
+  try {
+    TestCodecLocal();
+    Client client(argv[1]);
+    TestIntrospection(client);
+    TestPutGet(client);
+    TestCalls(client);
+    TestErrors(client);
+    TestWait(client);
+    TestActors(client);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "unexpected exception: %s\n", e.what());
+    return 1;
+  }
+  std::printf("CPP_FRONTEND_OK\n");
+  return 0;
+}

@@ -1,0 +1,249 @@
+// Cross-language value codec — C++ twin of ray_tpu/rpc/xlang.py.
+//
+// Reference parity: the reference's C++ frontend exchanges values with
+// Python through a language-neutral serialization layer (msgpack —
+// SURVEY.md §2.1; mount empty).  This header implements the same tagged
+// binary format the Python side defines:
+//
+//   'N' nil | 'T'/'F' bool | 'i'+8B int64 | 'd'+8B float64
+//   'b'+u32+n bytes | 's'+u32+n utf-8 str
+//   'l'+u32+values list | 'm'+u32+(k v)* map
+//
+// All fixed-width integers are big-endian.  Keep the two implementations
+// in lock-step; tests/test_cpp_frontend.py round-trips values across the
+// boundary in both directions.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace raytpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueMap = std::vector<std::pair<Value, Value>>;  // order-preserving
+
+class Value {
+ public:
+  enum class Kind { kNil, kBool, kInt, kFloat, kBytes, kStr, kList, kMap };
+
+  Value() : kind_(Kind::kNil) {}
+
+  static Value Nil() { return Value(); }
+  static Value Bool(bool b) {
+    Value v; v.kind_ = Kind::kBool; v.int_ = b ? 1 : 0; return v;
+  }
+  static Value Int(int64_t i) {
+    Value v; v.kind_ = Kind::kInt; v.int_ = i; return v;
+  }
+  static Value Float(double d) {
+    Value v; v.kind_ = Kind::kFloat; v.float_ = d; return v;
+  }
+  static Value Bytes(std::string data) {
+    Value v; v.kind_ = Kind::kBytes; v.str_ = std::move(data); return v;
+  }
+  static Value Str(std::string text) {
+    Value v; v.kind_ = Kind::kStr; v.str_ = std::move(text); return v;
+  }
+  static Value List(ValueList items) {
+    Value v; v.kind_ = Kind::kList; v.list_ = std::move(items); return v;
+  }
+  static Value Map(ValueMap entries) {
+    Value v; v.kind_ = Kind::kMap; v.map_ = std::move(entries); return v;
+  }
+
+  Kind kind() const { return kind_; }
+  bool is_nil() const { return kind_ == Kind::kNil; }
+
+  bool AsBool() const { Expect(Kind::kBool); return int_ != 0; }
+  int64_t AsInt() const { Expect(Kind::kInt); return int_; }
+  double AsFloat() const { Expect(Kind::kFloat); return float_; }
+  const std::string& AsBytes() const { Expect(Kind::kBytes); return str_; }
+  const std::string& AsStr() const { Expect(Kind::kStr); return str_; }
+  const ValueList& AsList() const { Expect(Kind::kList); return list_; }
+  const ValueMap& AsMap() const { Expect(Kind::kMap); return map_; }
+
+  // Map convenience: first entry whose key is the given string.
+  const Value* Find(const std::string& key) const {
+    Expect(Kind::kMap);
+    for (const auto& kv : map_) {
+      if (kv.first.kind_ == Kind::kStr && kv.first.str_ == key)
+        return &kv.second;
+    }
+    return nullptr;
+  }
+
+  bool operator==(const Value& o) const {
+    if (kind_ != o.kind_) return false;
+    switch (kind_) {
+      case Kind::kNil: return true;
+      case Kind::kBool:
+      case Kind::kInt: return int_ == o.int_;
+      case Kind::kFloat: return float_ == o.float_;
+      case Kind::kBytes:
+      case Kind::kStr: return str_ == o.str_;
+      case Kind::kList: return list_ == o.list_;
+      case Kind::kMap: return map_ == o.map_;
+    }
+    return false;
+  }
+
+  void Encode(std::string* out) const;
+  std::string Encode() const {
+    std::string out;
+    Encode(&out);
+    return out;
+  }
+  // Decodes one value from [*pos, data.size()); advances *pos.
+  static Value Decode(const std::string& data, size_t* pos);
+  static Value DecodeAll(const std::string& data) {
+    size_t pos = 0;
+    Value v = Decode(data, &pos);
+    if (pos != data.size())
+      throw std::runtime_error("xlang: trailing bytes after value");
+    return v;
+  }
+
+ private:
+  void Expect(Kind k) const {
+    if (kind_ != k) throw std::runtime_error("xlang: wrong value kind");
+  }
+
+  Kind kind_;
+  int64_t int_ = 0;
+  double float_ = 0;
+  std::string str_;
+  ValueList list_;
+  ValueMap map_;
+};
+
+namespace detail {
+
+inline void PutU32(std::string* out, uint32_t n) {
+  char b[4] = {static_cast<char>(n >> 24), static_cast<char>(n >> 16),
+               static_cast<char>(n >> 8), static_cast<char>(n)};
+  out->append(b, 4);
+}
+
+inline void PutI64(std::string* out, int64_t v) {
+  uint64_t n = static_cast<uint64_t>(v);
+  char b[8];
+  for (int i = 7; i >= 0; --i) { b[i] = static_cast<char>(n); n >>= 8; }
+  out->append(b, 8);
+}
+
+inline uint32_t GetU32(const std::string& d, size_t* pos) {
+  if (*pos + 4 > d.size()) throw std::runtime_error("xlang: truncated");
+  uint32_t n = 0;
+  for (int i = 0; i < 4; ++i)
+    n = (n << 8) | static_cast<uint8_t>(d[(*pos)++]);
+  return n;
+}
+
+inline int64_t GetI64(const std::string& d, size_t* pos) {
+  if (*pos + 8 > d.size()) throw std::runtime_error("xlang: truncated");
+  uint64_t n = 0;
+  for (int i = 0; i < 8; ++i)
+    n = (n << 8) | static_cast<uint8_t>(d[(*pos)++]);
+  return static_cast<int64_t>(n);
+}
+
+}  // namespace detail
+
+inline void Value::Encode(std::string* out) const {
+  switch (kind_) {
+    case Kind::kNil:
+      out->push_back('N');
+      return;
+    case Kind::kBool:
+      out->push_back(int_ ? 'T' : 'F');
+      return;
+    case Kind::kInt:
+      out->push_back('i');
+      detail::PutI64(out, int_);
+      return;
+    case Kind::kFloat: {
+      out->push_back('d');
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(float_), "ieee-754 double");
+      std::memcpy(&bits, &float_, 8);
+      detail::PutI64(out, static_cast<int64_t>(bits));
+      return;
+    }
+    case Kind::kBytes:
+    case Kind::kStr:
+      out->push_back(kind_ == Kind::kBytes ? 'b' : 's');
+      detail::PutU32(out, static_cast<uint32_t>(str_.size()));
+      out->append(str_);
+      return;
+    case Kind::kList:
+      out->push_back('l');
+      detail::PutU32(out, static_cast<uint32_t>(list_.size()));
+      for (const auto& v : list_) v.Encode(out);
+      return;
+    case Kind::kMap:
+      out->push_back('m');
+      detail::PutU32(out, static_cast<uint32_t>(map_.size()));
+      for (const auto& kv : map_) {
+        kv.first.Encode(out);
+        kv.second.Encode(out);
+      }
+      return;
+  }
+}
+
+inline Value Value::Decode(const std::string& data, size_t* pos) {
+  if (*pos >= data.size())
+    throw std::runtime_error("xlang: truncated frame (missing tag)");
+  char tag = data[(*pos)++];
+  switch (tag) {
+    case 'N': return Nil();
+    case 'T': return Bool(true);
+    case 'F': return Bool(false);
+    case 'i': return Int(detail::GetI64(data, pos));
+    case 'd': {
+      int64_t raw = detail::GetI64(data, pos);
+      uint64_t bits = static_cast<uint64_t>(raw);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return Float(d);
+    }
+    case 'b':
+    case 's': {
+      uint32_t n = detail::GetU32(data, pos);
+      if (*pos + n > data.size())
+        throw std::runtime_error("xlang: truncated payload");
+      std::string payload = data.substr(*pos, n);
+      *pos += n;
+      return tag == 'b' ? Bytes(std::move(payload))
+                        : Str(std::move(payload));
+    }
+    case 'l': {
+      uint32_t n = detail::GetU32(data, pos);
+      ValueList items;
+      items.reserve(n);
+      for (uint32_t i = 0; i < n; ++i) items.push_back(Decode(data, pos));
+      return List(std::move(items));
+    }
+    case 'm': {
+      uint32_t n = detail::GetU32(data, pos);
+      ValueMap entries;
+      entries.reserve(n);
+      for (uint32_t i = 0; i < n; ++i) {
+        Value k = Decode(data, pos);
+        Value v = Decode(data, pos);
+        entries.emplace_back(std::move(k), std::move(v));
+      }
+      return Map(std::move(entries));
+    }
+    default:
+      throw std::runtime_error("xlang: unknown tag byte");
+  }
+}
+
+}  // namespace raytpu

@@ -10,7 +10,8 @@ counting with lineage reconstruction, an autoscaler runtime loop,
 health-check failure detection, runtime environments, a GCS KV store +
 pubsub, collectives (XLA device-mesh + KV-rendezvous process groups), an
 RPC control plane with a head daemon / client mode / job submission /
-CLI, observability (metrics endpoint, structured logs, Chrome-trace
+CLI, a C++ client frontend over a cross-language gateway (``cpp/``,
+``cross_language.export``), observability (metrics endpoint, structured logs, Chrome-trace
 timeline), and the library family (``data``, ``train``, ``tune``,
 ``serve``, ``rllib``, ``workflow``) — with the scheduling/packing data
 planes evaluated as dense TPU computations (JAX/XLA/Pallas) per
@@ -36,8 +37,8 @@ def __getattr__(name):
     if name in _API_NAMES:
         from . import api
         return getattr(api, name)
-    if name in ("util", "experimental", "data", "train", "tune",
-                "serve", "workflow", "rllib"):
+    if name in ("util", "experimental", "cross_language", "data", "train",
+                "tune", "serve", "workflow", "rllib"):
         # NOT `from . import util`: that re-enters __getattr__ via the
         # fromlist hasattr probe before the submodule import finishes.
         # Only submodules that EXIST belong here — forwarding a missing

@@ -4,6 +4,15 @@ One reader thread per connection; each REQUEST runs on its own worker
 thread so a long/blocking handler (``ray.get``) never stalls the other
 requests pipelined on the same connection — the same property gRPC's
 completion queues give the reference (SURVEY.md §1 layer 2).
+
+The connection lifecycle is codec-agnostic: subclasses swap the frame
+codec and request/reply shapes via the ``_decode_request`` /
+``_encode_reply`` / ``_error_payload`` / ``_invoke`` hooks (the
+cross-language gateway reuses everything but the pickle codec —
+``rpc/xlang_gateway.py``).  Replies are encoded OUTSIDE the write lock,
+and an encode failure is itself sent as a typed error reply — a payload
+the codec rejects must never leave a synchronous client blocked waiting
+for a reply that died on the server.
 """
 
 from __future__ import annotations
@@ -12,7 +21,7 @@ import socket
 import threading
 import traceback
 
-from .wire import recv_frame, send_frame
+from .wire import recv_frame, send_raw_frame
 
 
 class RpcServer:
@@ -43,6 +52,28 @@ class RpcServer:
     def add_handler(self, name: str, fn) -> None:
         self._handlers[name] = fn
 
+    # -- codec hooks (pickle protocol; overridden by the xlang gateway) ----
+    def _recv_request(self, conn):
+        """One decoded request frame, or None on clean EOF."""
+        return recv_frame(conn)
+
+    def _decode_request(self, frame):
+        """frame -> (req_id, method, args, kwargs), or None to drop the
+        connection on a protocol violation."""
+        req_id, method, args, kwargs = frame
+        return req_id, method, args, kwargs
+
+    def _encode_reply(self, req_id, ok: bool, payload) -> bytes:
+        from ..runtime.serialization import serialize
+        return serialize((req_id, ok, payload))
+
+    def _error_payload(self, e: BaseException):
+        return (type(e).__name__, str(e), traceback.format_exc())
+
+    def _invoke(self, fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+    # -- connection lifecycle ----------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stopped:
             try:
@@ -62,12 +93,18 @@ class RpcServer:
         try:
             while True:
                 try:
-                    frame = recv_frame(conn)
-                except (ConnectionError, OSError):
+                    frame = self._recv_request(conn)
+                except (ConnectionError, OSError, ValueError):
                     return
                 if frame is None:
                     return
-                req_id, method, args, kwargs = frame
+                try:
+                    parsed = self._decode_request(frame)
+                except (TypeError, ValueError):
+                    return      # malformed request: drop the conn
+                if parsed is None:
+                    return
+                req_id, method, args, kwargs = parsed
                 threading.Thread(
                     target=self._run_handler,
                     args=(conn, wlock, req_id, method, args, kwargs),
@@ -86,14 +123,19 @@ class RpcServer:
             fn = self._handlers.get(method)
             if fn is None:
                 raise AttributeError(f"no rpc method {method!r}")
-            result = fn(*args, **kwargs)
+            result = self._invoke(fn, args, kwargs)
             ok, payload = True, result
         except BaseException as e:     # noqa: BLE001 — typed error reply
-            ok, payload = False, (type(e).__name__, str(e),
-                                  traceback.format_exc())
+            ok, payload = False, self._error_payload(e)
+        try:
+            data = self._encode_reply(req_id, ok, payload)
+        except Exception as e:          # result outside the codec's subset
+            ok = False
+            data = self._encode_reply(req_id, False,
+                                      self._error_payload(e))
         try:
             with wlock:
-                send_frame(conn, (req_id, ok, payload))
+                send_raw_frame(conn, data)
         except (OSError, ConnectionError):
             pass                # client went away; nothing to tell it
 

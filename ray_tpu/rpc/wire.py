@@ -1,7 +1,9 @@
 """Frame codec shared by the RPC server and client.
 
-Frames are ``4-byte big-endian length + cloudpickle payload`` over a
-stream socket.  Requests are ``(req_id, method, args, kwargs)``; replies
+Frames are ``4-byte big-endian length + payload`` over a stream socket.
+The raw layer (``send_raw_frame``/``recv_raw_frame``) is codec-agnostic
+and shared with the cross-language gateway; this module's default codec
+is cloudpickle.  Requests are ``(req_id, method, args, kwargs)``; replies
 are ``(req_id, ok: bool, payload)`` where a non-ok payload is
 ``(exc_type_name, message, traceback_str)``.
 """
@@ -17,13 +19,12 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 512 * 1024 * 1024       # sanity bound, not a protocol limit
 
 
-def send_frame(sock: socket.socket, obj) -> None:
-    data = serialize(obj)
+def send_raw_frame(sock: socket.socket, data: bytes) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
-def recv_frame(sock: socket.socket):
-    """One frame, or None on clean EOF."""
+def recv_raw_frame(sock: socket.socket) -> bytes | None:
+    """One frame's payload bytes, or None on clean EOF."""
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
@@ -33,7 +34,17 @@ def recv_frame(sock: socket.socket):
     body = _recv_exact(sock, n)
     if body is None:
         raise ConnectionError("connection closed mid-frame")
-    return deserialize(body)
+    return body
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    send_raw_frame(sock, serialize(obj))
+
+
+def recv_frame(sock: socket.socket):
+    """One frame, or None on clean EOF."""
+    body = recv_raw_frame(sock)
+    return None if body is None else deserialize(body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:

@@ -31,9 +31,11 @@ class HeadNode:
     def __init__(self, resources: dict | None = None,
                  num_workers: int | None = None,
                  system_config: dict | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 xlang_port: int | None = 0):
         from .. import api
         from ..rpc import RpcServer
+        from ..rpc.xlang_gateway import XlangGateway
         from .job_manager import JobManager
         api.init(resources=resources, num_workers=num_workers,
                  system_config=system_config)
@@ -42,6 +44,9 @@ class HeadNode:
         self.jobs = JobManager(self._rt.cluster.session_dir)
         self.server = RpcServer(self._handlers(), host=host, port=port)
         self.server.start()
+        # cross-language surface (C++ frontend); xlang_port=None disables
+        self.xlang = None if xlang_port is None else \
+            XlangGateway(self._rt, host=host, port=xlang_port)
         self.jobs.head_address = self.server.address
         self._stop_event = threading.Event()
 
@@ -54,6 +59,8 @@ class HeadNode:
 
     def stop(self) -> None:
         self.jobs.stop_all()
+        if self.xlang is not None:
+            self.xlang.stop()
         self.server.stop()
         from .. import api
         api.shutdown()
@@ -177,6 +184,7 @@ class HeadNode:
         cluster = self._rt.cluster
         return {
             "address": self.address,
+            "xlang_address": self.xlang.address if self.xlang else None,
             "session_dir": cluster.session_dir,
             "nodes": api.nodes(),
             "available_resources": api.available_resources(),

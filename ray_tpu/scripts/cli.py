@@ -60,6 +60,9 @@ def cmd_head(args) -> int:
                     port=args.port)
     _write_address(head.address)
     print(f"ray_tpu head listening on {head.address}", flush=True)
+    if head.xlang is not None:
+        print(f"cross-language (C++) gateway on {head.xlang.address}",
+              flush=True)
     try:
         head.wait_for_shutdown()
     except KeyboardInterrupt:
