@@ -11,8 +11,8 @@ health-check failure detection, runtime environments, a GCS KV store +
 pubsub, collectives (XLA device-mesh + KV-rendezvous process groups), an
 RPC control plane with a head daemon / client mode / job submission /
 CLI, a C++ client frontend over a cross-language gateway (``cpp/``,
-``cross_language.export``), observability (metrics endpoint, structured logs, Chrome-trace
-timeline), and the library family (``data``, ``train``, ``tune``,
+``cross_language.export``), observability (metrics endpoint, dashboard HTTP server, structured
+logs, Chrome-trace timeline), and the library family (``data``, ``train``, ``tune``,
 ``serve``, ``rllib``, ``workflow``) — with the scheduling/packing data
 planes evaluated as dense TPU computations (JAX/XLA/Pallas) per
 BASELINE.json's north star.  Remaining gaps are tracked in VERDICT.md.

@@ -134,6 +134,12 @@ class Cluster:
         if port:
             from .runtime.metrics import MetricsExporter
             self.metrics = MetricsExporter(self, port)
+        dash_port = get_config().dashboard_port
+        self.dashboard = None
+        if dash_port:
+            from .runtime.dashboard import Dashboard
+            self.dashboard = Dashboard(self, dash_port,
+                                       host=get_config().dashboard_host)
         self._head_row: int | None = None
 
     def _reclaim_object(self, oid) -> None:
@@ -363,6 +369,8 @@ class Cluster:
             r.stop()
         if self.metrics is not None:
             self.metrics.shutdown()
+        if self.dashboard is not None:
+            self.dashboard.shutdown()
         self.events.close()
         self.arena.close()
         import shutil

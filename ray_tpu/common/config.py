@@ -166,6 +166,9 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Padded number of distinct scheduling classes per device batch."),
     # -- observability ------------------------------------------------------
     "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
+    "dashboard_port": (int, 0, "0 disables the dashboard HTTP server."),
+    "dashboard_host": (str, "127.0.0.1",
+                       "Bind host for the dashboard HTTP server."),
     "event_log_enabled": (bool, True, "Emit timeline events."),
     "log_dir": (str, "", "'' => <session_dir>/logs."),
 }

@@ -48,6 +48,8 @@ class HeadNode:
         self.xlang = None if xlang_port is None else \
             XlangGateway(self._rt, host=host, port=xlang_port)
         self.jobs.head_address = self.server.address
+        if self._rt.cluster.dashboard is not None:
+            self._rt.cluster.dashboard.attach_jobs(self.jobs)
         self._stop_event = threading.Event()
 
     @property
@@ -185,6 +187,8 @@ class HeadNode:
         return {
             "address": self.address,
             "xlang_address": self.xlang.address if self.xlang else None,
+            "dashboard_url": (cluster.dashboard.url
+                              if cluster.dashboard else None),
             "session_dir": cluster.session_dir,
             "nodes": api.nodes(),
             "available_resources": api.available_resources(),

@@ -1,0 +1,70 @@
+"""Shared scaffolding for the head's background HTTP endpoints.
+
+The metrics exporter and the dashboard are both stdlib
+``ThreadingHTTPServer``s on a daemon thread; this base owns the server
+lifecycle and error discipline (handler exceptions answer as JSON 500s
+rather than dropping the connection) so the two surfaces cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class BackgroundHTTPServer:
+    """Subclass and implement ``route(request)``; use ``reply`` to answer.
+
+    ``port=0`` binds an ephemeral port (read it from ``self.port``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "http"):
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API)
+                try:
+                    owner.route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — 500 + JSON body
+                    try:
+                        owner.reply(self, json.dumps(
+                            {"error": type(e).__name__,
+                             "message": str(e)}).encode(),
+                            "application/json", status=500)
+                    except OSError:
+                        pass
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"{name}-{self.port}")
+        self._thread.start()
+
+    def route(self, request: BaseHTTPRequestHandler) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def reply(request, body: bytes, content_type: str,
+              status: int = 200) -> None:
+        request.send_response(status)
+        request.send_header("Content-Type", content_type)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)
+
+    @staticmethod
+    def not_found(request) -> None:
+        request.send_response(404)
+        request.end_headers()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

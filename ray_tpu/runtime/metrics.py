@@ -15,8 +15,7 @@ to ``MetricsExporter`` directly binds an ephemeral port (tests).
 
 from __future__ import annotations
 
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from .http_server import BackgroundHTTPServer
 
 
 def _fmt(name: str, value, help_text: str, labels: dict | None = None,
@@ -120,38 +119,16 @@ def render_metrics(cluster) -> str:
     return "\n".join(out) + "\n"
 
 
-class MetricsExporter:
+class MetricsExporter(BackgroundHTTPServer):
     """Scrape endpoint: ``GET /metrics`` on ``metrics_export_port``."""
 
-    def __init__(self, cluster, port: int):
+    def __init__(self, cluster, port: int, host: str = "127.0.0.1"):
         self._cluster = cluster
+        super().__init__(host=host, port=port, name="metrics")
 
-        exporter = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):          # noqa: N802 (stdlib API)
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = render_metrics(exporter._cluster).encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):  # silence per-request stderr spam
-                pass
-
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name=f"metrics-{self.port}")
-        self._thread.start()
-
-    def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+    def route(self, request) -> None:
+        if request.path.rstrip("/") not in ("", "/metrics"):
+            self.not_found(request)
+            return
+        self.reply(request, render_metrics(self._cluster).encode(),
+                   "text/plain; version=0.0.4")
