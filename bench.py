@@ -13,8 +13,11 @@ What is timed, per heartbeat round (the pipeline a raylet heartbeat runs):
      from per-class queues, matching the reference ClusterTaskManager's
      SchedulingClass-keyed queue).
 Rounds run software-pipelined (dispatch all, then one batched fetch), which
-is how a continuously-beating scheduler overlaps transfer with compute; p50
-is over per-round wall time at steady state.  Scheduling-class *grouping* is
+is how a continuously-beating scheduler overlaps transfer with compute; the
+fetch stacks all rounds on device and packs counts to int16 (provably safe:
+a count is bounded by its class's queue depth < 2^15), halving bytes on the
+host link — transfer is the dominant term, so this matters.  p50 is over
+per-round wall time at steady state.  Scheduling-class *grouping* is
 not timed: classes are interned at task submission (TaskSpec
 .scheduling_class), identical to the reference.
 
@@ -76,27 +79,36 @@ def main():
 
     totals, avail, node_mask, reqs, counts = build_problem()
     thr = threshold_fp(0.5)
+    # int16 packing safety: a per-node count never exceeds its class's
+    # queue depth
+    assert counts.max() < 2 ** 15, counts.max()
 
     d = jnp.asarray
     args = (d(totals), d(avail), d(node_mask), d(reqs), d(counts),
             jnp.ones((N_CLASSES, N_NODES), dtype=bool), jnp.int32(thr))
 
+    @jax.jit
+    def pack_rounds(outs):
+        return jnp.stack(outs).astype(jnp.int16)
+
     # warmup/compile (np.asarray is the reliable sync on every backend)
-    np.asarray(schedule_grouped(*args)[0])
+    np.asarray(pack_rounds([schedule_grouped(*args)[0]
+                            for _ in range(ROUNDS)]))
 
     per_round = []
     for _ in range(REPS):
         t0 = time.perf_counter()
         outs = [schedule_grouped(*args)[0] for _ in range(ROUNDS)]
-        hosts = jax.device_get(outs)
+        hosts = np.asarray(pack_rounds(outs))   # one (R, G, N+1) fetch
         assignments = [expand(h, N_NODES) for h in hosts]
         dt = (time.perf_counter() - t0) * 1e3 / ROUNDS
         per_round.append(dt)
     p50 = float(np.percentile(per_round, 50))
 
-    total = int(hosts[-1].sum())
+    total = int(hosts[-1].astype(np.int64).sum())
     assert total == N_TASKS, (total, N_TASKS)
-    placed = int(hosts[-1][:, :-1].sum())   # excl. the infeasible column
+    placed = int(hosts[-1][:, :-1].astype(np.int64).sum())  # excl. the
+    #                                                 infeasible column
     assert placed > N_TASKS // 2, f"only {placed}/{N_TASKS} placeable"
     assert sum(a.shape[0] for a in assignments[-1]) == N_TASKS
 
@@ -106,7 +118,7 @@ def main():
     from ray_tpu.scheduling import ClusterState, schedule_grouped_oracle
     st = ClusterState(totals.copy(), avail.copy(), node_mask.copy())
     want = schedule_grouped_oracle(st, reqs, counts, spread_threshold=0.5)
-    parity = bool((np.asarray(hosts[-1]) == want).all())
+    parity = bool((hosts[-1].astype(np.int32) == want).all())
 
     print(json.dumps({
         "metric": "p50 heartbeat time: 1M tasks x 1k nodes, bit-exact hybrid"
