@@ -10,7 +10,8 @@ counting with lineage reconstruction, an autoscaler runtime loop,
 health-check failure detection, runtime environments, a GCS KV store +
 pubsub, collectives (XLA device-mesh + KV-rendezvous process groups), an
 RPC control plane with a head daemon / client mode / job submission /
-CLI, a C++ client frontend over a cross-language gateway (``cpp/``,
+CLI / worker-node agents joining over RPC (``start --address=<head>``),
+a C++ client frontend over a cross-language gateway (``cpp/``,
 ``cross_language.export``), observability (metrics endpoint, dashboard HTTP server, structured
 logs, Chrome-trace timeline), and the library family (``data``, ``train``, ``tune``,
 ``serve``, ``rllib``, ``workflow``) — with the scheduling/packing data

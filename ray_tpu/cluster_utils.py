@@ -161,19 +161,32 @@ class Cluster:
     def add_node(self, resources: dict[str, float] | None = None,
                  num_workers: int = 2,
                  labels: dict[str, str] | None = None,
-                 wait: bool = True) -> NodeID:
+                 wait: bool = True, spawner=None,
+                 inline_objects: bool = False) -> NodeID:
         resources = resources or {"CPU": 2, "memory": 2}
         node_id = NodeID.from_random()
         with self._lock:
             row = self.crm.add_node(node_id,
                                     NodeResources(resources, labels))
             self._grow_bandwidth(row + 1)
-            raylet = Raylet(node_id, self, num_workers)
+            raylet = Raylet(node_id, self, num_workers, spawner=spawner,
+                            inline_objects=inline_objects)
             raylet.actor_manager = self.actor_manager
             self.raylets[row] = raylet
             if self._head_row is None:
                 self._head_row = row
-        raylet.start()
+        try:
+            raylet.start()
+        except BaseException:
+            # a remote spawner can fail mid-start (agent gone): unwind
+            # the CRM row so the scheduler never places onto a node
+            # whose raylet never ran
+            with self._lock:
+                self.raylets.pop(row, None)
+                self.crm.remove_node(node_id)
+                if self._head_row == row:
+                    self._head_row = None
+            raise
         self.events.emit("node", "node_added", node_row=row,
                          node_id=node_id.hex(), resources=resources)
         self.pubsub.publish("node", {"event": "added", "row": row,
@@ -188,6 +201,17 @@ class Cluster:
         for r in others:
             r._notify_dirty()
         return node_id
+
+    def add_remote_node(self, resources: dict[str, float] | None = None,
+                        num_workers: int = 2, spawner=None,
+                        labels: dict[str, str] | None = None) -> NodeID:
+        """A node whose worker processes live behind a node agent on
+        another machine (``runtime/node_agent.py``): same raylet, same
+        scheduling row — only the process transport differs, and objects
+        ship in-band (no shared arena across the machine boundary)."""
+        return self.add_node(resources=resources, num_workers=num_workers,
+                             labels=labels, spawner=spawner,
+                             inline_objects=True)
 
     def _grow_bandwidth(self, n: int) -> None:
         """Extend the bandwidth matrix to cover ``n`` rows (caller holds

@@ -29,7 +29,11 @@ class RemoteRpcError(RuntimeError):
 
 
 class RpcClient:
-    def __init__(self, address: str, timeout: float = 10.0):
+    def __init__(self, address: str, timeout: float = 10.0,
+                 on_close=None):
+        """``on_close`` fires once, from the reader thread, when the
+        connection drops (peer gone or local close) — the hook node
+        agents/hubs use for disconnect-driven cleanup."""
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
@@ -39,6 +43,7 @@ class RpcClient:
         self._pending: dict[int, list] = {}    # id -> [event, ok, payload]
         self._ids = itertools.count()
         self._closed = False
+        self._on_close = on_close
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True, name="rpc-reader")
         self._reader.start()
@@ -83,9 +88,20 @@ class RpcClient:
         # wake every waiter; they observe _closed and raise
         for slot in list(self._pending.values()):
             slot[0].set()
+        if self._on_close is not None:
+            try:
+                self._on_close()
+            except Exception:       # noqa: BLE001 — cleanup must not kill
+                pass                # the reader's unwind
 
     def close(self) -> None:
         self._closed = True
+        # shutdown wakes our reader thread (close alone may not
+        # interrupt its blocking recv), which then runs on_close
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -148,6 +148,12 @@ class RpcServer:
         with self._lock:
             conns = list(self._conns)
         for c in conns:
+            # shutdown BEFORE close: close() alone does not wake a peer
+            # (or our own reader thread) blocked in recv on this socket
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:

@@ -50,6 +50,11 @@ class HeadNode:
         self.jobs.head_address = self.server.address
         if self._rt.cluster.dashboard is not None:
             self._rt.cluster.dashboard.attach_jobs(self.jobs)
+        # worker-node agents join through these handlers
+        from .node_agent import AgentHub
+        self.agent_hub = AgentHub(self._rt.cluster)
+        for name, fn in self.agent_hub.handlers().items():
+            self.server.add_handler(name, fn)
         self._stop_event = threading.Event()
 
     @property
@@ -61,6 +66,7 @@ class HeadNode:
 
     def stop(self) -> None:
         self.jobs.stop_all()
+        self.agent_hub.shutdown()
         if self.xlang is not None:
             self.xlang.stop()
         self.server.stop()

@@ -1,0 +1,403 @@
+"""Worker-node agent: joins a remote machine's workers to a head.
+
+Reference parity: ``ray start --address=<head>`` boots a worker node
+whose raylet registers with the GCS and leases local worker processes to
+the cluster over gRPC (``NodeManagerService`` — SURVEY.md §1 layers 2-4,
+§3.1; mount empty).  The rebuild keeps ALL scheduling/lease/env state in
+the head process (single source of truth: the head's ``WorkerPool`` and
+``Raylet`` run unchanged) and makes only the process transport remote:
+
+    head                                  agent machine
+    ----                                  -------------
+    Raylet ── WorkerPool ── AgentSpawner ──TCP── NodeAgent ── pipe ── worker
+                             (spawner seam)        (dumb relay)
+
+- The **agent** (``NodeAgent``) is a dumb relay daemon: it spawns
+  ``worker_main`` processes locally (same ``LocalSpawner`` mechanics as
+  the head) and shuttles their pipe frames to/from the head over the RPC
+  plane, then registers its node with the head.
+- The **head** (``AgentHub`` + ``AgentSpawner``) serves the agent's
+  registration, creates a normal raylet row whose pool spawns through
+  the agent, and routes incoming worker frames to virtual pipe
+  connections.  The raylet runs with ``inline_objects=True``: remote
+  workers share no shm arena, so every object payload ships in-band
+  (the reference's cross-node path similarly leaves zero-copy plasma
+  behind at the node boundary).
+
+An agent disconnect (process death, network drop) surfaces through the
+RPC client's ``on_close`` and drives the existing ``remove_node`` drain:
+running tasks retry elsewhere, exactly like a node death.
+
+Limitation (v1, noted): runtime-env ``working_dir``/``py_modules``
+staging paths live on the head's filesystem, so tasks with those envs
+only resolve on agents sharing that filesystem.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..common.ids import NodeID
+from .worker_pool import LocalSpawner
+
+_EOF = object()
+
+
+# ---------------------------------------------------------------------------
+# agent process side
+# ---------------------------------------------------------------------------
+
+class NodeAgent:
+    """The daemon on a worker machine: spawn + relay, no state."""
+
+    def __init__(self, head_address: str,
+                 resources: dict[str, float] | None = None,
+                 num_workers: int = 2,
+                 labels: dict[str, str] | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        from ..rpc import RpcClient, RpcServer
+        self._spawner = LocalSpawner()
+        self._workers: dict[int, tuple] = {}    # index -> (proc, conn)
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self.server = RpcServer({
+            "a_spawn": self._a_spawn,
+            "a_send": self._a_send,
+            "a_kill": self._a_kill,
+            "a_stop": self._a_stop,
+            "a_ping": lambda: "ok",
+        }, host=host, port=port).start()
+        # head link: frames flow agent->head on this client; its loss
+        # (head died) ends the agent — workers without a head are orphans
+        self._head = RpcClient(head_address,
+                               on_close=self._stop_event.set)
+        self.agent_id = NodeID.from_random().hex()
+        self.node_id_hex = self._head.call(
+            "agent_register", self.agent_id, self.server.address,
+            resources, num_workers, labels)
+
+    def wait_for_shutdown(self, timeout: float | None = None) -> bool:
+        return self._stop_event.wait(timeout)
+
+    def stop(self) -> None:
+        try:
+            self._head.call("agent_bye", self.agent_id, timeout=5.0)
+        except Exception:       # noqa: BLE001 — head may already be gone
+            pass
+        self._a_stop()
+
+    # -- RPC handlers (called by the head) ----------------------------------
+    def _a_spawn(self, index: int, env_payload: dict | None) -> int:
+        """Spawn a local worker; returns its real pid (0 = failed)."""
+        proc, conn = self._spawner.spawn(index, None, env_payload)
+        with self._lock:
+            self._workers[index] = (proc, conn)
+        threading.Thread(target=self._pump, args=(index, conn),
+                         daemon=True, name=f"agent-pump-{index}").start()
+        return proc.pid or 0
+
+    def _a_send(self, index: int, msg) -> bool:
+        with self._lock:
+            entry = self._workers.get(index)
+        if entry is None:
+            return False
+        try:
+            entry[1].send(msg)
+            return True
+        except (OSError, BrokenPipeError):
+            return False
+
+    def _a_kill(self, index: int) -> None:
+        with self._lock:
+            entry = self._workers.get(index)
+        if entry is not None:
+            try:
+                entry[0].terminate()
+            except Exception:   # noqa: BLE001
+                pass
+
+    def _a_stop(self) -> str:
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for proc, conn in workers:
+            try:
+                conn.send(("shutdown",))
+            except (OSError, BrokenPipeError):
+                pass
+        for proc, conn in workers:
+            proc.join(timeout=2.0)
+            if proc.is_alive():
+                proc.terminate()
+            try:
+                conn.close()
+            except Exception:   # noqa: BLE001
+                pass
+        self._stop_event.set()
+        return "stopping"
+
+    # -- worker->head pump ---------------------------------------------------
+    def _pump(self, index: int, conn) -> None:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._head.call("agent_frame", self.agent_id, index, msg)
+            except Exception:   # noqa: BLE001 — head gone: nothing to
+                return          # relay to; the on_close hook is already
+                #                 ending the agent
+        try:
+            self._head.call("agent_eof", self.agent_id, index)
+        except Exception:       # noqa: BLE001
+            pass
+        with self._lock:
+            self._workers.pop(index, None)
+
+
+# ---------------------------------------------------------------------------
+# head side
+# ---------------------------------------------------------------------------
+
+class _RemoteConn:
+    """Virtual pipe endpoint: send = RPC to the agent; recv = queue fed
+    by the hub's incoming agent_frame handler."""
+
+    def __init__(self, spawner: "AgentSpawner", index: int):
+        self._spawner = spawner
+        self._index = index
+        self._q: queue.Queue = queue.Queue()
+        self.eof = threading.Event()
+
+    def send(self, msg) -> None:
+        self._spawner.send_to_worker(self._index, msg)
+
+    def recv(self):
+        item = self._q.get()
+        if item is _EOF:
+            raise EOFError("remote worker gone")
+        return item
+
+    def feed(self, msg) -> None:
+        self._q.put(msg)
+
+    def close(self) -> None:
+        self.feed(_EOF)
+
+
+class _RemoteProc:
+    """Process facade over the agent's real worker process."""
+
+    def __init__(self, spawner: "AgentSpawner", index: int,
+                 conn: _RemoteConn, pid: int):
+        self._spawner = spawner
+        self._index = index
+        self._conn = conn
+        self.pid = pid          # the real pid on the agent machine
+
+    def is_alive(self) -> bool:
+        return not (self._conn.eof.is_set() or self._spawner._closed)
+
+    def terminate(self) -> None:
+        self._spawner.kill_worker(self._index)
+
+    kill = terminate
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._spawner._closed:
+            return              # link gone: nothing to wait for
+        self._conn.eof.wait(timeout)
+
+
+class AgentSpawner:
+    """The WorkerPool spawner seam, backed by one registered agent."""
+
+    def __init__(self, agent_address: str, on_disconnect=None):
+        from ..rpc import RpcClient
+        self._conns: dict[int, _RemoteConn] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._client = RpcClient(agent_address,
+                                 on_close=self._handle_disconnect)
+        self._on_disconnect = on_disconnect
+
+    # -- spawner interface (WorkerPool) --------------------------------------
+    def spawn(self, index: int, arena_path, env_payload):
+        conn = _RemoteConn(self, index)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("agent is gone")
+            self._conns[index] = conn
+        try:
+            pid = self._client.call("a_spawn", index, env_payload,
+                                    timeout=60.0)
+        except Exception:
+            with self._lock:
+                self._conns.pop(index, None)
+            raise
+        if not pid:
+            with self._lock:
+                self._conns.pop(index, None)
+            raise RuntimeError("agent failed to spawn worker")
+        return _RemoteProc(self, index, conn, pid), conn
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._client.call("a_stop", timeout=5.0)
+        except Exception:       # noqa: BLE001 — agent may already be gone
+            pass
+        self._client.close()
+        self._drop_all()
+
+    # -- frame plumbing ------------------------------------------------------
+    def send_to_worker(self, index: int, msg) -> None:
+        with self._lock:
+            if self._closed or index not in self._conns:
+                raise BrokenPipeError("remote worker gone")
+        try:
+            # no deadline: a slow worker draining a large frame is NOT a
+            # dead worker (a timeout here would dead-mark it and run the
+            # task twice); a truly lost link raises RpcConnectionError
+            ok = self._client.call("a_send", index, msg)
+        except Exception as e:
+            raise BrokenPipeError(f"agent link lost: {e}") from e
+        if not ok:
+            raise BrokenPipeError("remote worker pipe closed")
+
+    def kill_worker(self, index: int) -> None:
+        try:
+            self._client.call("a_kill", index, timeout=10.0)
+        except Exception:       # noqa: BLE001 — best-effort, like SIGKILL
+            pass                # on an already-dead pid
+
+    def feed_frame(self, index: int, msg) -> None:
+        with self._lock:
+            conn = self._conns.get(index)
+        if conn is not None:
+            conn.feed(msg)
+
+    def feed_eof(self, index: int) -> None:
+        with self._lock:
+            conn = self._conns.pop(index, None)
+        if conn is not None:
+            conn.eof.set()
+            conn.feed(_EOF)
+
+    def _drop_all(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.eof.set()
+            conn.feed(_EOF)
+
+    def _handle_disconnect(self) -> None:
+        """Agent link dropped: every remote worker is unreachable.
+        Drain the node FIRST (remove_node → pool.shutdown latches the
+        pool, so worker-reader threads exiting on the EOFs below do not
+        race a respawn through this dead link), then EOF the readers."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if not already and self._on_disconnect is not None:
+            self._on_disconnect()
+        self._drop_all()
+
+
+class AgentHub:
+    """Head-side registry: serves agent registration + frame routing.
+
+    Attach its handlers to the head's RpcServer (``HeadNode`` does this;
+    tests may attach to any server fronting a cluster)."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._agents: dict[str, tuple[AgentSpawner, object]] = {}
+        self._lock = threading.Lock()
+
+    def handlers(self) -> dict:
+        return {
+            "agent_register": self.register,
+            "agent_frame": self.frame,
+            "agent_eof": self.eof,
+            "agent_bye": self.bye,
+        }
+
+    def register(self, agent_id: str, agent_address: str,
+                 resources: dict | None, num_workers: int,
+                 labels: dict | None) -> str:
+        # the disconnect hook is live from the START — an agent dying
+        # mid-registration must still tear down whatever exists by then
+        spawner = AgentSpawner(
+            agent_address,
+            on_disconnect=lambda: self._on_agent_lost(agent_id))
+        # route frames BEFORE adding the node: add_remote_node blocks on
+        # worker-ready frames, which arrive through this table — adding
+        # the entry after would drop them and wedge the registration
+        with self._lock:
+            self._agents[agent_id] = (spawner, None)
+        try:
+            node_id = self._cluster.add_remote_node(
+                resources=resources, num_workers=num_workers,
+                spawner=spawner, labels=labels)
+        except BaseException:
+            with self._lock:
+                self._agents.pop(agent_id, None)
+            spawner.stop()
+            raise
+        with self._lock:
+            if agent_id not in self._agents:
+                # disconnected while the node was coming up: the hook
+                # already popped the entry but had no node to remove
+                vanished = True
+            else:
+                self._agents[agent_id] = (spawner, node_id)
+                vanished = False
+        if vanished:
+            try:
+                self._cluster.remove_node(node_id)
+            except (KeyError, ValueError):
+                pass
+            raise ConnectionError("agent disconnected during "
+                                  "registration")
+        return node_id.hex()
+
+    def frame(self, agent_id: str, index: int, msg) -> None:
+        entry = self._agents.get(agent_id)
+        if entry is not None:
+            entry[0].feed_frame(index, msg)
+
+    def eof(self, agent_id: str, index: int) -> None:
+        entry = self._agents.get(agent_id)
+        if entry is not None:
+            entry[0].feed_eof(index)
+
+    def bye(self, agent_id: str) -> None:
+        self._on_agent_lost(agent_id)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            agents = list(self._agents)
+        for agent_id in agents:
+            self._on_agent_lost(agent_id)
+
+    def _on_agent_lost(self, agent_id: str) -> None:
+        with self._lock:
+            entry = self._agents.pop(agent_id, None)
+        if entry is None:
+            return
+        spawner, node_id = entry
+        # drain first so the raylet stops dispatching into the void,
+        # then drop the link; remove_node tolerates an already-gone node
+        if node_id is not None:
+            try:
+                self._cluster.remove_node(node_id)
+            except (KeyError, ValueError):
+                pass            # already removed / cluster torn down
+        spawner.stop()

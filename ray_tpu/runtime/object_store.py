@@ -227,6 +227,15 @@ class MemoryStore:
                     continue
                 self._release_entry(entry)
 
+    def inline_bytes(self, oid, desc) -> bytes:
+        """Copy a PINNED shm descriptor's payload and release the pin —
+        the in-band form shipped to workers that share no arena (remote
+        node agents, ``raylet.inline_objects``)."""
+        try:
+            return bytes(self.arena.view(desc[1], desc[2]))
+        finally:
+            self.unpin([(oid, desc[1])])
+
     def unpin(self, pins: Iterable) -> None:
         """Release descriptor pins taken by ``descriptor_of`` /
         ``get_descriptors_blocking`` (one unpin per shm descriptor handed

@@ -40,9 +40,13 @@ from .worker_pool import WorkerHandle, WorkerPool
 
 
 class Raylet:
-    def __init__(self, node_id, cluster, num_workers: int):
+    def __init__(self, node_id, cluster, num_workers: int,
+                 spawner=None, inline_objects: bool = False):
         self.node_id = node_id
         self.cluster = cluster
+        # remote-node raylet: workers live on another machine (node
+        # agent) and share no arena — every object payload ships in-band
+        self.inline_objects = inline_objects
         self.crm = cluster.crm
         self.row = self.crm.row_of(node_id)
         self.store = cluster.store
@@ -76,10 +80,12 @@ class Raylet:
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
         arena = getattr(cluster, "arena", None)
-        self.pool = WorkerPool(num_workers, self._on_worker_message,
-                               self._on_worker_death,
-                               on_idle=self._notify_dirty,
-                               arena_path=arena.path if arena else None)
+        self.pool = WorkerPool(
+            num_workers, self._on_worker_message, self._on_worker_death,
+            on_idle=self._notify_dirty,
+            arena_path=(arena.path if arena and not inline_objects
+                        else None),
+            spawner=spawner)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"raylet-{self.row}")
 
@@ -747,7 +753,12 @@ class Raylet:
                     vanished = a.id
                     break
                 if desc[0] == "s":
-                    pinned.append((a.id, desc[1]))
+                    if self.inline_objects:
+                        # remote worker: copy out of the arena under the
+                        # pin, ship bytes, release immediately
+                        desc = ("b", self.store.inline_bytes(a.id, desc))
+                    else:
+                        pinned.append((a.id, desc[1]))
                 if desc[0] == "v" and isinstance(desc[1], RayTaskError):
                     dep_error = desc[1]
                     break
@@ -1260,6 +1271,12 @@ class Raylet:
         """Ship get descriptors; shm descriptors were pinned by the store,
         so record them for release on the worker's get_ack (every reply
         with shm descriptors gets exactly one ack)."""
+        if self.inline_objects:
+            # remote worker: no shared arena, so copy under the pin and
+            # release now — in-band descriptors are never acked
+            descs = [("b", self.store.inline_bytes(o, d))
+                     if d[0] == "s" else d
+                     for o, d in zip(oids, descs)]
         shm_pins = [(o, d[1]) for o, d in zip(oids, descs) if d[0] == "s"]
         if shm_pins:
             with worker.pin_lock:

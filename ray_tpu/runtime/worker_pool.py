@@ -26,6 +26,39 @@ _SCRUB_ENV = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
 _spawn_env_lock = threading.Lock()
 
 
+class LocalSpawner:
+    """Default transport: spawn worker processes on THIS machine over
+    multiprocessing pipes.  The pool is parameterized over this seam so a
+    remote node agent can supply workers on another machine while every
+    piece of lease/env/death bookkeeping stays in the one pool
+    (``runtime/node_agent.py``)."""
+
+    def __init__(self):
+        self._ctx = mp.get_context("spawn")
+
+    def spawn(self, index: int, arena_path: str | None,
+              env_payload: dict | None):
+        """Returns ``(proc, conn)``, already started; ``proc`` must offer
+        terminate/join/is_alive, ``conn`` send/recv/close."""
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        with _spawn_env_lock:
+            saved = {k: os.environ.pop(k) for k in _SCRUB_ENV
+                     if k in os.environ}
+            try:
+                proc = self._ctx.Process(
+                    target=worker_main,
+                    args=(child_conn, index, arena_path, env_payload),
+                    daemon=True, name=f"rt-worker-{index}")
+                proc.start()
+            finally:
+                os.environ.update(saved)
+        child_conn.close()
+        return proc, parent_conn
+
+    def stop(self) -> None:
+        pass
+
+
 class WorkerHandle:
     def __init__(self, index: int, proc, conn):
         self.index = index
@@ -72,13 +105,14 @@ class WorkerPool:
                  on_message: Callable[[WorkerHandle, tuple], None],
                  on_death: Callable[[WorkerHandle], None],
                  on_idle: Callable[[], None] | None = None,
-                 arena_path: str | None = None):
+                 arena_path: str | None = None,
+                 spawner=None):
         self._num = num_workers
         self._on_message = on_message
         self._on_death = on_death
         self._on_idle = on_idle or (lambda: None)
         self._arena_path = arena_path
-        self._ctx = mp.get_context("spawn")
+        self._spawner = spawner if spawner is not None else LocalSpawner()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._workers: list[WorkerHandle] = []
@@ -104,20 +138,8 @@ class WorkerPool:
                 return None
             index = self._next_index
             self._next_index += 1
-        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
-        with _spawn_env_lock:
-            saved = {k: os.environ.pop(k) for k in _SCRUB_ENV
-                     if k in os.environ}
-            try:
-                proc = self._ctx.Process(
-                    target=worker_main,
-                    args=(child_conn, index, self._arena_path,
-                          env_payload),
-                    daemon=True, name=f"rt-worker-{index}")
-                proc.start()
-            finally:
-                os.environ.update(saved)
-        child_conn.close()
+        proc, parent_conn = self._spawner.spawn(index, self._arena_path,
+                                                env_payload)
         handle = WorkerHandle(index, proc, parent_conn)
         handle.dedicated = dedicated
         handle.env_key = env_key
@@ -345,3 +367,4 @@ class WorkerPool:
                 h.conn.close()
             except Exception:
                 pass
+        self._spawner.stop()

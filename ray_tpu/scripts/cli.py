@@ -70,10 +70,52 @@ def cmd_head(args) -> int:
     return 0
 
 
+def cmd_agent(args) -> int:
+    """Foreground worker-node agent: joins a head, serves its workers
+    (reference: ``ray start --address=<head>`` boots a worker node)."""
+    from ..runtime.node_agent import NodeAgent
+    resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if args.labels else None
+    num_workers = args.num_workers if args.num_workers is not None else 2
+    agent = NodeAgent(args.address, resources=resources,
+                      num_workers=num_workers, labels=labels)
+    print(f"ray_tpu node agent joined {args.address} as node "
+          f"{agent.node_id_hex[:16]}… ({num_workers} workers)",
+          flush=True)
+    try:
+        agent.wait_for_shutdown()
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
 def cmd_start(args) -> int:
+    if args.head and args.address:
+        raise SystemExit("--head and --address are mutually exclusive")
+    if not args.head and args.address:
+        if args.block:          # foreground agent (supervisors)
+            return cmd_agent(args)
+        # detached worker-node agent joining an existing head
+        os.makedirs(STATE_DIR, exist_ok=True)
+        log_path = os.path.join(STATE_DIR, "agent.log")
+        cmd = [sys.executable, "-m", "ray_tpu", "agent",
+               "--address", args.address]
+        if args.resources:
+            cmd += ["--resources", args.resources]
+        if args.num_workers is not None:
+            cmd += ["--num-workers", str(args.num_workers)]
+        if args.labels:
+            cmd += ["--labels", args.labels]
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(cmd, stdout=log_f, stderr=log_f,
+                                    start_new_session=True)
+        print(f"started node agent (pid {proc.pid}) joining "
+              f"{args.address}")
+        print(f"logs: {log_path}")
+        return 0
     if not args.head:
-        raise SystemExit("only --head is supported (worker nodes join "
-                         "in-process via cluster_utils.Cluster)")
+        raise SystemExit("pass --head to start a head, or "
+                         "--address=<head> to join one")
     if args.block:
         return cmd_head(args)
     os.makedirs(STATE_DIR, exist_ok=True)
@@ -286,13 +328,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     ps = sub.add_parser("start", help="start cluster daemons")
     ps.add_argument("--head", action="store_true")
+    ps.add_argument("--address", default=None,
+                    help="join an existing head as a worker node "
+                         "(mutually exclusive with --head)")
     ps.add_argument("--port", type=int, default=0)
     ps.add_argument("--resources", default=None,
                     help='JSON, e.g. \'{"CPU": 8, "memory": 16}\'')
     ps.add_argument("--num-workers", type=int, default=None)
+    ps.add_argument("--labels", default=None,
+                    help="JSON node labels (worker nodes only)")
     ps.add_argument("--block", action="store_true",
                     help="run in the foreground")
     ps.set_defaults(fn=cmd_start)
+
+    pa = sub.add_parser("agent",
+                        help="run a worker-node agent in foreground")
+    pa.add_argument("--address", required=True,
+                    help="head RPC address (host:port)")
+    pa.add_argument("--resources", default=None)
+    pa.add_argument("--num-workers", type=int, default=2)
+    pa.add_argument("--labels", default=None, help="JSON node labels")
+    pa.set_defaults(fn=cmd_agent)
 
     pst = sub.add_parser("stop", help="stop the running cluster")
     pst.add_argument("--address", default=None)

@@ -1,0 +1,228 @@
+"""Worker-node agents: remote workers joining a head over RPC.
+
+Scenario sources: ``ray start --address=<head>`` semantics — a worker
+node registers with the head and its workers execute cluster tasks; node
+death drains and retries (SURVEY.md §1 layers 2-4, §3.1, §5.3;
+re-derived, not copied).  The agent here runs either in-process (its
+workers are still real subprocesses and frames still cross a real TCP
+link) or as the actual CLI daemon subprocess.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.head import HeadNode
+from ray_tpu.runtime.node_agent import NodeAgent
+
+REMOTE_RES = {"CPU": 2, "memory": 2, "remote_slot": 2}
+
+
+def _wait_nodes(n, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(ray_tpu.nodes()) == n:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"expected {n} nodes, have {len(ray_tpu.nodes())}")
+
+
+@pytest.fixture
+def head():
+    node = HeadNode(resources={"CPU": 2, "memory": 2}, num_workers=1)
+    try:
+        yield node
+    finally:
+        node.stop()
+
+
+@pytest.fixture
+def agent(head):
+    a = NodeAgent(head.address, resources=REMOTE_RES, num_workers=2,
+                  labels={"zone": "remote"})
+    _wait_nodes(2)
+    try:
+        yield a
+    finally:
+        a.stop()
+
+
+@ray_tpu.remote
+def _pids():
+    return os.getpid(), os.getppid()
+
+
+class TestRemoteExecution:
+    def test_tasks_run_in_agent_workers(self, head, agent):
+        # pin to the remote node via its exclusive custom resource
+        f = _pids.options(resources={"CPU": 1, "remote_slot": 1})
+        pids = ray_tpu.get([f.remote() for _ in range(4)], timeout=60)
+        me = os.getpid()
+        for wpid, wppid in pids:
+            assert wpid != me
+            assert wppid == me      # in-process agent spawned them
+        # two workers on the remote node: at least two distinct pids
+        assert len({p for p, _ in pids}) >= 1
+
+    def test_head_and_remote_mix(self, head, agent):
+        @ray_tpu.remote
+        def double(x):
+            return 2 * x
+
+        refs = [double.options(
+            resources={"CPU": 1, "remote_slot": 1} if i % 2
+            else {"CPU": 1}).remote(i) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(8)]
+
+    def test_large_objects_cross_the_boundary(self, head, agent):
+        # head-side arena object as a remote task arg (inline path)
+        blob = os.urandom(300_000)
+        ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote(resources={"CPU": 1, "remote_slot": 1})
+        def length(b):
+            return len(b)
+
+        assert ray_tpu.get(length.remote(ref), timeout=60) == 300_000
+
+        # large remote result seals into the head arena and reads back
+        @ray_tpu.remote(resources={"CPU": 1, "remote_slot": 1})
+        def produce(n):
+            return b"\x07" * n
+
+        out = ray_tpu.get(produce.remote(400_000), timeout=60)
+        assert len(out) == 400_000 and out[:2] == b"\x07\x07"
+
+    def test_remote_get_of_head_object(self, head, agent):
+        blob_ref = ray_tpu.put(os.urandom(200_000))
+
+        @ray_tpu.remote(resources={"CPU": 1, "remote_slot": 1})
+        def peek(refs):
+            return len(ray_tpu.get(refs[0]))
+
+        # ship the REF (worker gets it via an in-band get reply)
+        assert ray_tpu.get(peek.remote([blob_ref]), timeout=60) \
+            == 200_000
+
+    def test_nested_submission_from_remote_worker(self, head, agent):
+        @ray_tpu.remote
+        def child(x):
+            return x + 1
+
+        @ray_tpu.remote(resources={"CPU": 1, "remote_slot": 1})
+        def parent(x):
+            return ray_tpu.get(child.remote(x)) + 10
+
+        assert ray_tpu.get(parent.remote(5), timeout=60) == 16
+
+    def test_actor_on_remote_node(self, head, agent):
+        @ray_tpu.remote(resources={"remote_slot": 1})
+        class Counter:
+            def __init__(self):
+                self.n = 0
+                self.pid = os.getpid()
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+            def where(self):
+                return self.pid
+
+        c = Counter.remote()
+        assert ray_tpu.get([c.incr.remote() for _ in range(3)],
+                           timeout=60) == [1, 2, 3]
+        assert ray_tpu.get(c.where.remote(), timeout=60) != os.getpid()
+        ray_tpu.kill(c)
+
+    def test_node_labels_from_agent(self, head, agent):
+        rows = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        assert any(n["Labels"] == {"zone": "remote"}
+                   for n in rows.values())
+        assert agent.node_id_hex in rows
+
+
+class TestAgentLifecycle:
+    def test_graceful_stop_removes_node(self, head):
+        a = NodeAgent(head.address, resources=REMOTE_RES, num_workers=1)
+        _wait_nodes(2)
+        a.stop()
+        _wait_nodes(1)
+        # cluster still healthy for head-local work
+        @ray_tpu.remote
+        def ping():
+            return "ok"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "ok"
+
+    def test_running_task_retries_when_agent_dies(self, head):
+        a = NodeAgent(head.address, resources=REMOTE_RES, num_workers=1)
+        _wait_nodes(2)
+
+        @ray_tpu.remote(max_retries=2)
+        def flaky_slow(path):
+            # first run parks on the remote node until the agent dies;
+            # the retry (anywhere) completes immediately
+            import os as _os
+            import time as _time
+            if not _os.path.exists(path):
+                open(path, "w").close()
+                _time.sleep(600)    # >> the get timeout: only a RETRY
+                #                      can produce the result in time
+            return "done"
+
+        marker = os.path.join(head._rt.cluster.session_dir,
+                              "agent_died_marker")
+        # SOFT affinity: the first attempt lands on the (live) remote
+        # node, the retry falls back to the head once it is gone
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        ref = flaky_slow.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=NodeID.from_hex(a.node_id_hex),
+                soft=True)).remote(marker)
+        deadline = time.monotonic() + 30
+        while not os.path.exists(marker):
+            assert time.monotonic() < deadline, "task never started"
+            time.sleep(0.05)
+        # hard death: the agent's RPC server vanishes (no goodbye) —
+        # the head's spawner link drops and the disconnect drain runs
+        a.server.stop()
+        assert ray_tpu.get(ref, timeout=90) == "done"
+        _wait_nodes(1)
+        a._a_stop()             # reap the orphaned worker processes
+
+
+class TestCliAgent:
+    def test_cli_agent_subprocess(self, head):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "agent",
+             "--address", head.address,
+             "--resources", json.dumps(REMOTE_RES),
+             "--num-workers", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            _wait_nodes(2, timeout=90)
+
+            @ray_tpu.remote(resources={"CPU": 1, "remote_slot": 1})
+            def where():
+                return os.getppid()
+
+            agent_pid = ray_tpu.get(where.remote(), timeout=90)
+            assert agent_pid == proc.pid        # worker is the agent's
+            #                                     child, not ours
+            # agent SIGKILL == node death: head notices and drains
+            os.kill(proc.pid, signal.SIGKILL)
+            _wait_nodes(1, timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=30)
