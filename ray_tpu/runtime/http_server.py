@@ -17,14 +17,24 @@ class BackgroundHTTPServer:
     """Subclass and implement ``route(request)``; use ``reply`` to answer.
 
     ``port=0`` binds an ephemeral port (read it from ``self.port``).
+    Non-GET verbs answer 501 unless the subclass widens
+    ``allowed_methods``.
     """
+
+    allowed_methods: tuple = ("GET",)
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  name: str = "http"):
         owner = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):          # noqa: N802 (stdlib API)
+            def _dispatch(self):
+                if self.command not in owner.allowed_methods:
+                    # read-only surfaces (dashboard, metrics) must not
+                    # silently treat mutating verbs as GETs
+                    self.send_response(501)
+                    self.end_headers()
+                    return
                 try:
                     owner.route(self)
                 except BrokenPipeError:
@@ -37,6 +47,11 @@ class BackgroundHTTPServer:
                             "application/json", status=500)
                     except OSError:
                         pass
+
+            do_GET = _dispatch      # noqa: N815 (stdlib API names)
+            do_POST = _dispatch     # noqa: N815
+            do_PUT = _dispatch      # noqa: N815
+            do_DELETE = _dispatch   # noqa: N815
 
             def log_message(self, *a):  # silence per-request stderr spam
                 pass

@@ -5,13 +5,16 @@ Reference parity: ``ray.serve`` (``python/ray/serve/``) —
 application graph, ``serve.run`` materializes it as a controller +
 replica actors, ``DeploymentHandle.remote`` routes requests across
 replicas, autoscaling tracks ongoing requests against a target, and
-handles compose (a deployment takes another's handle) — SURVEY.md §1
-layer 14; mount empty.
+handles compose (a deployment takes another's handle), and an HTTP
+proxy routes ``route_prefix`` requests into the replica sets — SURVEY.md
+§1 layer 14; mount empty.
 """
 
 from .deployment import (Application, Deployment, DeploymentHandle,
-                         delete, deployment, get_deployment_handle, run,
-                         status)
+                         delete, deployment, get_deployment_handle,
+                         http_address, run, shutdown, start, status)
+from .http_proxy import HTTPRequest
 
 __all__ = ["Application", "Deployment", "DeploymentHandle", "delete",
-           "deployment", "get_deployment_handle", "run", "status"]
+           "deployment", "get_deployment_handle", "http_address",
+           "HTTPRequest", "run", "shutdown", "start", "status"]

@@ -270,15 +270,54 @@ class _Running:
     controller: Any
     handle: DeploymentHandle
     deployment: Deployment = None
+    route_prefix: str | None = None
 
 
 _apps: dict[str, _Running] = {}
 _apps_lock = threading.Lock()
+_ingress = None
+_ingress_lock = threading.Lock()
 
 
-def run(app: Application, *, name: str = "default") -> DeploymentHandle:
+def start(http_host: str = "127.0.0.1", http_port: int = 0,
+          request_timeout_s: float = 30.0) -> str:
+    """Start the HTTP ingress (idempotent); returns its address.
+    ``http_port=0`` binds an ephemeral port — pass 8000 for the
+    reference's fixed default."""
+    return _ensure_ingress(http_host, http_port,
+                           request_timeout_s).address
+
+
+def _ensure_ingress(http_host: str = "127.0.0.1", http_port: int = 0,
+                    request_timeout_s: float = 30.0):
+    global _ingress
+    from .http_proxy import HttpIngress
+    with _ingress_lock:
+        if _ingress is None:
+            _ingress = HttpIngress(http_host, http_port,
+                                   request_timeout_s)
+        return _ingress
+
+
+def _ingress_if_running():
+    with _ingress_lock:
+        return _ingress
+
+
+def http_address() -> str | None:
+    with _ingress_lock:
+        return _ingress.address if _ingress is not None else None
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str | None = None) -> DeploymentHandle:
     import ray_tpu
     from ray_tpu.runtime.serialization import serialize
+    if route_prefix is not None:
+        # validate BEFORE materializing actors: a bad prefix must not
+        # leak a live replica set nothing can reach or tear down
+        from .http_proxy import _norm_prefix
+        route_prefix = _norm_prefix(route_prefix)
     dep = app.deployment
     controller_cls = ray_tpu.remote(_Controller)
     controller = controller_cls.remote(
@@ -287,10 +326,17 @@ def run(app: Application, *, name: str = "default") -> DeploymentHandle:
     # materialize the replica set before returning the handle
     ray_tpu.get(controller.num_replicas.remote(), timeout=60)
     handle = DeploymentHandle(controller)
+    if route_prefix is not None:
+        _ensure_ingress().add_route(route_prefix, handle)
     with _apps_lock:
         old = _apps.pop(name, None)
-        _apps[name] = _Running(controller, handle, dep)
+        _apps[name] = _Running(controller, handle, dep, route_prefix)
     if old is not None:
+        ingress = _ingress_if_running()
+        if old.route_prefix is not None and ingress is not None:
+            # ownership-checked: only drops the route if the OLD handle
+            # still holds it (same-prefix re-run already swapped it)
+            ingress.remove_route(old.route_prefix, old.handle)
         _teardown(old)
     return handle
 
@@ -329,4 +375,21 @@ def delete(name: str = "default") -> None:
     with _apps_lock:
         running = _apps.pop(name, None)
     if running is not None:
+        ingress = _ingress_if_running()
+        if running.route_prefix is not None and ingress is not None:
+            ingress.remove_route(running.route_prefix, running.handle)
         _teardown(running)
+
+
+def shutdown() -> None:
+    """Tear down every app and the HTTP ingress (reference:
+    ``serve.shutdown()``)."""
+    global _ingress
+    with _apps_lock:
+        names = list(_apps)
+    for n in names:
+        delete(n)
+    with _ingress_lock:
+        if _ingress is not None:
+            _ingress.shutdown()
+            _ingress = None

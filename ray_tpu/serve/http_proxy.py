@@ -1,0 +1,116 @@
+"""Serve HTTP ingress: routes requests to deployment replica pools.
+
+Reference parity: Serve's HTTP proxy is its primary surface — an HTTP
+server on every node routes ``/route_prefix`` requests into deployment
+replica sets, JSON in/out, with per-request timeouts
+(``python/ray/serve/_private/proxy.py``, SURVEY.md §1 layer 14; mount
+empty).  Here one ingress runs in the driver/head process on the shared
+``BackgroundHTTPServer`` scaffolding; ``serve.run(..., route_prefix=…)``
+binds a prefix to the application's handle.
+
+Replicas see a plain ``HTTPRequest`` value (method, path, query, body)
+and may return ``bytes``/``str`` (sent raw) or any JSON-serializable
+value (sent as ``application/json``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from urllib.parse import parse_qsl, urlsplit
+
+from ..runtime.http_server import BackgroundHTTPServer
+
+
+@dataclass
+class HTTPRequest:
+    """What a deployment's ``__call__`` receives for an HTTP request."""
+
+    method: str
+    path: str                       # full path, route prefix included
+    query: dict = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self):
+        return json.loads(self.body) if self.body else None
+
+
+class HttpIngress(BackgroundHTTPServer):
+    allowed_methods = ("GET", "POST", "PUT", "DELETE")
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 30.0):
+        self._routes: dict[str, object] = {}    # prefix -> handle
+        self._rlock = threading.Lock()
+        self._timeout = request_timeout_s
+        super().__init__(host=host, port=port, name="serve-http")
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def add_route(self, prefix: str, handle) -> None:
+        prefix = _norm_prefix(prefix)
+        with self._rlock:
+            self._routes[prefix] = handle
+
+    def remove_route(self, prefix: str, handle=None) -> None:
+        """Drop a route; with ``handle`` given, only if that handle
+        still owns it (a later app may have claimed the prefix)."""
+        prefix = _norm_prefix(prefix)
+        with self._rlock:
+            if handle is None or self._routes.get(prefix) is handle:
+                self._routes.pop(prefix, None)
+
+    def routes(self) -> list[str]:
+        with self._rlock:
+            return sorted(self._routes)
+
+    # -- request path --------------------------------------------------------
+    def route(self, request) -> None:
+        import ray_tpu
+        parts = urlsplit(request.path)
+        path = parts.path or "/"
+        if path == "/-/routes":     # the reference's route listing
+            self.reply(request, json.dumps(self.routes()).encode(),
+                       "application/json")
+            return
+        handle = self._match(path)
+        if handle is None:
+            self.reply(request, json.dumps(
+                {"error": "NotFound",
+                 "message": f"no route matches {path!r}",
+                 "routes": self.routes()}).encode(),
+                "application/json", status=404)
+            return
+        n = int(request.headers.get("Content-Length") or 0)
+        body = request.rfile.read(n) if n else b""
+        req = HTTPRequest(method=request.command, path=path,
+                          query=dict(parse_qsl(parts.query)), body=body)
+        result = ray_tpu.get(handle.remote(req), timeout=self._timeout)
+        if isinstance(result, (bytes, bytearray)):
+            self.reply(request, bytes(result), "application/octet-stream")
+        elif isinstance(result, str):
+            self.reply(request, result.encode(),
+                       "text/plain; charset=utf-8")
+        else:
+            self.reply(request, json.dumps(result).encode(),
+                       "application/json")
+
+    def _match(self, path: str):
+        """Longest-prefix route match on path-segment boundaries."""
+        with self._rlock:
+            best = None
+            for prefix, handle in self._routes.items():
+                if path == prefix or prefix == "/" or \
+                        path.startswith(prefix + "/"):
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, handle)
+            return best[1] if best else None
+
+
+def _norm_prefix(prefix: str) -> str:
+    if not prefix.startswith("/"):
+        raise ValueError(f"route_prefix must start with '/': {prefix!r}")
+    return prefix.rstrip("/") or "/"

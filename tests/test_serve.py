@@ -157,3 +157,153 @@ class TestAutoscaling:
             handle.remote()
             time.sleep(0.3)
         assert serve.status()["num_replicas"] <= 2
+
+
+class TestHttpIngress:
+    @pytest.fixture(autouse=True)
+    def http_cleanup(self):
+        yield
+        serve.shutdown()
+
+    def _get(self, url, data=None, method=None):
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(url, data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.headers["Content-Type"], r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type", ""), e.read()
+
+    def test_json_roundtrip_and_routing(self):
+        import json as _json
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, request):
+                return {"method": request.method,
+                        "path": request.path,
+                        "q": request.query,
+                        "payload": request.json()}
+
+        serve.run(Echo.bind(), route_prefix="/echo")
+        base = serve.http_address()
+        assert base is not None
+
+        status, ctype, body = self._get(
+            f"{base}/echo/sub?a=1&b=two", data=_json.dumps(
+                {"x": [1, 2]}).encode(), method="POST")
+        assert status == 200 and ctype.startswith("application/json")
+        out = _json.loads(body)
+        assert out == {"method": "POST", "path": "/echo/sub",
+                       "q": {"a": "1", "b": "two"},
+                       "payload": {"x": [1, 2]}}
+
+        # route listing (reference /-/routes)
+        status, _, body = self._get(f"{base}/-/routes")
+        assert status == 200 and _json.loads(body) == ["/echo"]
+
+        # unknown route -> 404 with the route table
+        status, _, body = self._get(f"{base}/nope")
+        assert status == 404
+        assert "/echo" in _json.loads(body)["routes"]
+
+    def test_raw_and_text_responses_and_errors(self):
+        @serve.deployment
+        class Mixed:
+            def __call__(self, request):
+                kind = request.query.get("kind", "text")
+                if kind == "bytes":
+                    return b"\x01\x02\x03"
+                if kind == "boom":
+                    raise ValueError("kaboom")
+                return "hello"
+
+        serve.run(Mixed.bind(), route_prefix="/mix")
+        base = serve.http_address()
+
+        status, ctype, body = self._get(f"{base}/mix?kind=text")
+        assert (status, body) == (200, b"hello")
+        assert ctype.startswith("text/plain")
+
+        status, ctype, body = self._get(f"{base}/mix?kind=bytes")
+        assert (status, body) == (200, b"\x01\x02\x03")
+        assert ctype.startswith("application/octet-stream")
+
+        import json as _json
+        status, _, body = self._get(f"{base}/mix?kind=boom")
+        assert status == 500
+        err = _json.loads(body)
+        assert "kaboom" in err["message"]
+
+    def test_delete_removes_route_and_longest_prefix_wins(self):
+        @serve.deployment
+        class A:
+            def __call__(self, request):
+                return "A"
+
+        @serve.deployment
+        class B:
+            def __call__(self, request):
+                return "B"
+
+        serve.run(A.bind(), name="appa", route_prefix="/api")
+        serve.run(B.bind(), name="appb", route_prefix="/api/deep")
+        base = serve.http_address()
+        assert self._get(f"{base}/api/x")[2] == b"A"
+        assert self._get(f"{base}/api/deep/x")[2] == b"B"
+        serve.delete("appb")
+        assert self._get(f"{base}/api/deep/x")[2] == b"A"
+        serve.delete("appa")
+        assert self._get(f"{base}/api/x")[0] == 404
+
+    def test_route_ownership_survives_rerun_and_delete(self):
+        @serve.deployment
+        class V1:
+            def __call__(self, request):
+                return "v1"
+
+        @serve.deployment
+        class V2:
+            def __call__(self, request):
+                return "v2"
+
+        # same app re-run under a trailing-slash variant of the prefix:
+        # the new route must survive the old one's cleanup
+        serve.run(V1.bind(), name="app", route_prefix="/p/")
+        base = serve.http_address()
+        assert self._get(f"{base}/p")[2] == b"v1"
+        serve.run(V2.bind(), name="app", route_prefix="/p")
+        assert self._get(f"{base}/p")[2] == b"v2"
+
+        # another app claims the prefix; deleting the first must not
+        # unroute it
+        serve.run(V1.bind(), name="claimer", route_prefix="/p")
+        serve.delete("app")
+        assert self._get(f"{base}/p")[2] == b"v1"
+        serve.delete("claimer")
+        assert self._get(f"{base}/p")[0] == 404
+
+    def test_invalid_prefix_rejected_before_actors_exist(self):
+        import pytest as _pytest
+
+        @serve.deployment
+        class X:
+            def __call__(self, request):
+                return "x"
+
+        with _pytest.raises(ValueError, match="route_prefix"):
+            serve.run(X.bind(), name="bad", route_prefix="nope")
+        assert serve.status("bad") == {"status": "NOT_RUNNING"}
+
+    def test_read_only_surfaces_refuse_mutating_verbs(self):
+        from ray_tpu.api import _get_runtime
+        from ray_tpu.runtime.dashboard import Dashboard
+        d = Dashboard(_get_runtime().cluster, 0)
+        try:
+            status, _, _ = self._get(
+                f"http://127.0.0.1:{d.port}/api/summary",
+                data=b"{}", method="POST")
+            assert status == 501
+        finally:
+            d.shutdown()
