@@ -3,8 +3,11 @@
 Reference parity: ``ray.data`` (``python/ray/data/``) — a ``Dataset`` is
 a list of object-store block references plus metadata; transforms
 (``map/map_batches/filter/flat_map/repartition/random_shuffle/sort``)
-run as tasks over blocks; consumers (``take/count/iter_batches/split``)
-resolve refs (SURVEY.md §1 layer 14, §2.2; mount empty).
+run as tasks over blocks; ``groupby`` aggregations run as per-block
+partials merged in a worker-side tree; ``read_text/read_csv`` map files
+to blocks and ``write_json`` writes one part per block; consumers
+(``take/count/iter_batches/split``) resolve refs (SURVEY.md §1 layer
+14, §2.2; mount empty).
 
 TPU-first: blocks are numpy-friendly lists or arrays living in the
 shared-memory arena (zero-copy into workers), ``map_batches`` is the
@@ -13,6 +16,8 @@ batches, not Python-loop rows), and ``split`` hands aligned shards to
 ``ray_tpu.train`` workers.
 """
 
+from .aggregate import GroupedDataset, read_csv, read_text
 from .dataset import Dataset, from_items, from_numpy, range  # noqa: A004
 
-__all__ = ["Dataset", "from_items", "from_numpy", "range"]
+__all__ = ["Dataset", "GroupedDataset", "from_items", "from_numpy",
+           "range", "read_csv", "read_text"]

@@ -152,6 +152,22 @@ class Dataset:
         merged = _merge_sorted(blocks, key)
         return _from_rows(merged, max(len(self._blocks), 1))
 
+    def groupby(self, key_fn: Callable | None = None):
+        """Group rows by ``key_fn(row)`` (identity when None); finish
+        with ``.count()/.sum()/.mean()/.aggregate(...)`` — distributed
+        per-block partials + a worker-side merge tree."""
+        from .aggregate import GroupedDataset
+        return GroupedDataset(self, key_fn)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._blocks + other._blocks,
+                       self._counts + other._counts)
+
+    def write_json(self, directory: str) -> list[str]:
+        """One ``part-NNNNN.json`` per block; returns written paths."""
+        from .aggregate import write_json
+        return write_json(self, directory)
+
     def split(self, n: int) -> list["Dataset"]:
         """N aligned shards (for per-worker ingest in ray_tpu.train)."""
         rows = self._materialize_rows()

@@ -103,3 +103,101 @@ class TestReorg:
         shards = rdata.range(10, parallelism=3).split(2)
         assert [s.take_all() for s in shards] == \
             [[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]]
+
+
+class TestGroupByAndIO:
+    def test_groupby_count_sum_mean(self):
+        ds = rdata.range(100, parallelism=7)
+        counts = dict(ds.groupby(lambda x: x % 3).count().take_all())
+        assert counts == {0: 34, 1: 33, 2: 33}
+
+        sums = dict(ds.groupby(lambda x: x % 2).sum().take_all())
+        assert sums == {0: sum(range(0, 100, 2)),
+                        1: sum(range(1, 100, 2))}
+
+        means = dict(ds.groupby(lambda x: x % 2).mean().take_all())
+        assert means[0] == pytest.approx(49.0)
+        assert means[1] == pytest.approx(50.0)
+
+    def test_groupby_general_aggregate(self):
+        rows = [("a", 3), ("b", 1), ("a", 5), ("c", 9), ("b", 2)]
+        ds = rdata.from_items(rows, parallelism=3)
+        out = dict(ds.groupby(lambda r: r[0]).aggregate(
+            init=lambda k: [],
+            accumulate=lambda acc, row: acc + [row[1]],
+            merge=lambda a, b: a + b).take_all())
+        assert {k: sorted(v) for k, v in out.items()} == \
+            {"a": [3, 5], "b": [1, 2], "c": [9]}
+
+    def test_groupby_identity_key(self):
+        ds = rdata.from_items(["x", "y", "x", "x"], parallelism=2)
+        assert dict(ds.groupby().count().take_all()) == {"x": 3, "y": 1}
+
+    def test_union(self):
+        a = rdata.range(5, parallelism=2)
+        b = rdata.range(3, parallelism=1)
+        u = a.union(b)
+        assert sorted(u.take_all()) == sorted(list(range(5)) +
+                                              list(range(3)))
+        assert u.count() == 8
+
+    def test_read_text_and_csv(self, tmp_path):
+        p1 = tmp_path / "a.txt"
+        p1.write_text("alpha\nbeta\n")
+        p2 = tmp_path / "b.txt"
+        p2.write_text("gamma\n")
+        ds = rdata.read_text([str(p1), str(p2)])
+        assert ds.take_all() == ["alpha", "beta", "gamma"]
+        assert ds.num_blocks() == 2
+
+        csv_dir = tmp_path / "csvs"
+        csv_dir.mkdir()
+        (csv_dir / "x.csv").write_text("name,score\nann,3\nbob,5\n")
+        rows = rdata.read_csv(str(csv_dir)).take_all()
+        assert rows == [{"name": "ann", "score": "3"},
+                        {"name": "bob", "score": "5"}]
+
+        with pytest.raises(FileNotFoundError):
+            rdata.read_text(str(tmp_path / "missing.txt"))
+
+    def test_write_json_roundtrip(self, tmp_path):
+        import json
+        ds = rdata.range(20, parallelism=4).map(lambda x: x * x)
+        paths = ds.write_json(str(tmp_path / "out"))
+        assert len(paths) == 4
+        rows = []
+        for p in paths:
+            with open(p) as f:
+                rows.extend(json.load(f))
+        assert sorted(rows) == [x * x for x in range(20)]
+
+    def test_groupby_composes_with_transforms(self):
+        ds = rdata.range(50, parallelism=4) \
+            .map(lambda x: x % 5) \
+            .groupby() \
+            .count() \
+            .filter(lambda kv: kv[0] < 2)
+        assert dict(ds.take_all()) == {0: 10, 1: 10}
+
+    def test_review_regressions(self, tmp_path):
+        import json
+        # numeric keys sort numerically, not by repr
+        ds = rdata.from_items([10, 2, 10, 2, 2], parallelism=2)
+        assert ds.groupby().count().take_all() == [(2, 3), (10, 2)]
+        # directory read skips subdirectories
+        d = tmp_path / "mixed"
+        (d / "sub").mkdir(parents=True)
+        (d / "a.txt").write_text("one\n")
+        assert rdata.read_text(str(d)).take_all() == ["one"]
+        # smaller re-write clears stale parts
+        out = str(tmp_path / "w")
+        rdata.range(8, parallelism=8).write_json(out)
+        rdata.range(4, parallelism=2).write_json(out)
+        import os as _os
+        parts = sorted(p for p in _os.listdir(out)
+                       if p.startswith("part-"))
+        assert len(parts) == 2
+        rows = []
+        for p in parts:
+            rows.extend(json.load(open(_os.path.join(out, p))))
+        assert sorted(rows) == [0, 1, 2, 3]
