@@ -24,9 +24,7 @@ import os
 from typing import Any, Callable
 
 
-def _api():
-    import ray_tpu
-    return ray_tpu
+from .dataset import _api
 
 
 # -- task bodies (run in workers) --------------------------------------------
@@ -50,7 +48,7 @@ def _merge_partials(merge, a: dict, b: dict) -> dict:
 
 def _read_text_file(path: str):
     with open(path, "r", encoding="utf-8") as f:
-        return [line.rstrip("\n") for line in f]
+        return [line.rstrip("\r\n") for line in f]
 
 
 def _read_csv_file(path: str):
@@ -159,14 +157,18 @@ def _read_files(paths, reader):
 
 def write_json(dataset, directory: str) -> list[str]:
     """One ``part-NNNNN.json`` per block; returns the written paths.
-    Existing part files are cleared first — a smaller re-write must not
-    leave stale parts for directory-globbing readers."""
+    Stale parts from a previous larger write are cleared only AFTER the
+    new writes all land — a failed write must not destroy the previous
+    output (each part itself lands via atomic rename)."""
     rt = _api()
     os.makedirs(directory, exist_ok=True)
-    for name in os.listdir(directory):
-        if name.startswith("part-") and name.endswith(".json"):
-            os.unlink(os.path.join(directory, name))
     writer = rt.remote(_write_json_block)
     refs = [writer.remote(b, os.path.join(directory, f"part-{i:05d}.json"))
             for i, b in enumerate(dataset._blocks)]
-    return rt.get(refs, timeout=300)
+    written = rt.get(refs, timeout=300)
+    keep = {os.path.basename(p) for p in written}
+    for name in os.listdir(directory):
+        if name.startswith("part-") and name.endswith(".json") \
+                and name not in keep:
+            os.unlink(os.path.join(directory, name))
+    return written

@@ -145,7 +145,7 @@ class TestGroupByAndIO:
         p1 = tmp_path / "a.txt"
         p1.write_text("alpha\nbeta\n")
         p2 = tmp_path / "b.txt"
-        p2.write_text("gamma\n")
+        p2.write_text("gamma\r\n")      # CRLF must not leak \r into rows
         ds = rdata.read_text([str(p1), str(p2)])
         assert ds.take_all() == ["alpha", "beta", "gamma"]
         assert ds.num_blocks() == 2
