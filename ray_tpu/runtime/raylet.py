@@ -39,6 +39,61 @@ from .serialization import (RayTaskError, WorkerCrashedError, deserialize,
 from .worker_pool import WorkerHandle, WorkerPool
 
 
+class _ClassQueue:
+    """Dispatch queue bucketed by scheduling-class key, FIFO per class
+    (the reference ClusterTaskManager keys its dispatch queues by
+    SchedulingClass — SURVEY.md §1 layer 4).  All mutation runs under
+    the owning raylet's ``_cv``; iteration order is class insertion
+    order, FIFO within a class."""
+
+    __slots__ = ("_by", "_key_of")
+
+    def __init__(self):
+        self._by: dict = {}             # class key -> deque[TaskID]
+        self._key_of: dict = {}         # TaskID -> class key
+
+    def append(self, task_id, key=None) -> None:
+        self._key_of[task_id] = key
+        dq = self._by.get(key)
+        if dq is None:
+            dq = self._by[key] = deque()
+        dq.append(task_id)
+
+    def remove(self, task_id) -> None:
+        """Raises ValueError when absent (deque.remove contract)."""
+        try:
+            key = self._key_of.pop(task_id)
+        except KeyError:
+            raise ValueError(task_id) from None
+        dq = self._by[key]
+        dq.remove(task_id)
+        if not dq:
+            del self._by[key]
+
+    def classes(self) -> list:
+        return list(self._by)
+
+    def bucket(self, key):
+        return self._by.get(key, ())
+
+    def clear(self) -> None:
+        self._by.clear()
+        self._key_of.clear()
+
+    def __contains__(self, task_id) -> bool:
+        return task_id in self._key_of
+
+    def __len__(self) -> int:
+        return len(self._key_of)
+
+    def __bool__(self) -> bool:
+        return bool(self._key_of)
+
+    def __iter__(self):
+        for dq in list(self._by.values()):
+            yield from list(dq)
+
+
 class Raylet:
     def __init__(self, node_id, cluster, num_workers: int,
                  spawner=None, inline_objects: bool = False):
@@ -55,7 +110,7 @@ class Raylet:
         self._policy = CompositeSchedulingPolicy()
         self._cv = threading.Condition()
         self._queue: deque[TaskID] = deque()        # awaiting PLACEMENT
-        self._local_queue: deque[TaskID] = deque()  # placed here, await dispatch
+        self._local_queue = _ClassQueue()   # placed here, await dispatch
         self._planned_cu = None     # dense planned-load vector (lazy width)
         self._waiting: dict[TaskID, int] = {}   # task -> missing dep count
         self._pull_pending: dict[TaskID, int] = {}  # task -> in-flight pulls
@@ -204,7 +259,9 @@ class Raylet:
                 self._planned_add(rec.spec.resources, 1)
             if pulls:
                 self._pull_pending[task_id] = len(pulls)
-            self._local_queue.append(task_id)
+            self._local_queue.append(
+                task_id,
+                rec.spec.resources.key() if rec is not None else None)
             self._local_since[task_id] = time.monotonic()
             self._dirty = True
             self._cv.notify_all()
@@ -617,121 +674,168 @@ class Raylet:
     def _drain_local(self) -> None:
         """Dispatch placed tasks to workers; stops scanning after a run of
         consecutive failures (worker/resource-starved queue parks until the
-        next idle/free event — no O(n^2) rescans)."""
+        next idle/free event).  The queue is bucketed by scheduling-class
+        key (the reference ClusterTaskManager's SchedulingClass-keyed
+        dispatch queues): a class whose resource demand cannot fit skips
+        the rest of its bucket, so a deep single-class backlog costs at
+        most one chunk copy per pass instead of a full queue scan;
+        buckets are visited oldest-head first for cross-class
+        fairness."""
+        from itertools import islice
         max_misses = 8
+        chunk_size = 128
         misses = 0
-        scanned = 0
-        failed_classes: set = set()     # resource classes that cannot fit
         env_missed: set = set()         # env keys already counted a miss
-        while misses < max_misses:
-            with self._cv:
-                if scanned >= len(self._local_queue):
+        kicked = False                  # autoscaler kicked this pass
+        with self._cv:
+            if not self._local_queue:
+                return
+            # oldest class first (head-entry enqueue time): bucket order
+            # must not starve a lone task of a late class behind an
+            # earlier class's steady stream — the fairness the flat FIFO
+            # gave, at class granularity
+            class_keys = sorted(
+                self._local_queue.classes(),
+                key=lambda k: min(
+                    (self._local_since.get(t, float("inf"))
+                     for t in islice(self._local_queue.bucket(k), 1)),
+                    default=float("inf")))
+            pull_pending = set(self._pull_pending)
+        for key in class_keys:
+            # buckets snapshot in CHUNKS: a class that cannot fit stops
+            # after one chunk, so a 100k-deep starved backlog costs a
+            # bounded copy per pass, not O(queue)
+            skipped = 0         # examined but left queued this pass
+            class_full = False
+            while not class_full:
+                if misses >= max_misses:
                     return
-                task_id = self._local_queue[scanned]
-            rec = self.task_manager.get(task_id)
-            if rec is None or rec.done:
                 with self._cv:
-                    try:
-                        self._local_queue.remove(task_id)
-                    except ValueError:
-                        continue            # concurrent cancel removed it
-                    self._local_since.pop(task_id, None)
-                    self._env_miss_since.pop(task_id, None)
-                    if rec is not None:
-                        self._planned_add(rec.spec.resources, -1)
-                continue
-            spec = rec.spec
-            with self._cv:
-                if task_id in self._pull_pending:
-                    scanned += 1        # args still in flight: skip
-                    continue
-            if spec.resources.key() in failed_classes:
-                scanned += 1
-                continue
-            # reserve resources BEFORE popping a worker (pool.release
-            # fires the idle wake-up, so a speculative pop-then-release
-            # would spin the loop)
-            if not self.crm.subtract(self.row, spec.resources):
-                if not failed_classes:
-                    # resource-starved local backlog is autoscaler demand
-                    asc = getattr(self.cluster, "autoscaler", None)
-                    if asc is not None:
-                        asc.kick()
-                failed_classes.add(spec.resources.key())
-                misses += 1
-                scanned += 1
-                continue
-            if spec.runtime_env:
-                worker, env_k = self._pop_env_worker(task_id, rec, spec)
-                if worker is None:
-                    # one miss per env KEY per scan (like failed_classes
-                    # for resources): a block of same-env tasks parked
-                    # at a barrier must not eat the whole miss budget
-                    # and starve runnable default tasks behind them
-                    if env_k is None or env_k not in env_missed:
-                        misses += 1
-                        if env_k is not None:
-                            env_missed.add(env_k)
-                    scanned += 1
-                    continue    # this task waits for its env worker (or
-                    # failed staging); others may still dispatch
-            else:
-                worker = self.pool.pop_idle()
-                if worker is None:
-                    # pipelined lease: commit the task to a BUSY worker's
-                    # soft queue (resources stay debited); the exec frame
-                    # ships the instant that worker's current result
-                    # lands, cutting the result->rescan->dispatch round
-                    # trip out of the tiny-task critical path
-                    depth = get_config().worker_pipeline_depth
-                    target = self.pool.pipeline_target(None, depth) \
-                        if depth > 1 else None
-                    if target is not None:
-                        committed = False
-                        with self._cv:
-                            # re-validate AT COMMIT: the target may have
-                            # died/blocked/been released since selection
-                            # (the reconcile sweep covers what still
-                            # slips through this non-atomic check)
-                            if not target.dead and not target.blocked \
-                                    and target.leased_task is not None:
-                                try:
-                                    self._local_queue.remove(task_id)
-                                except ValueError:
-                                    self.crm.add_back(self.row,
-                                                      spec.resources)
-                                    continue
-                                self._local_since.pop(task_id, None)
-                                self._env_miss_since.pop(task_id, None)
-                                self._planned_add(spec.resources, -1)
-                                target.assigned.append(
-                                    (task_id, time.monotonic()))
-                                self._assigned_total += 1
-                                committed = True
-                        if committed:
-                            # removal shifted queue indices: do NOT
-                            # bump `scanned`, or the next task gets
-                            # skipped for the rest of this pass
-                            continue
-                        self.crm.add_back(self.row, spec.resources)
-                        self._spill_stale_leases()
+                    chunk = list(islice(self._local_queue.bucket(key),
+                                        skipped, skipped + chunk_size))
+                if not chunk:
+                    break
+                for task_id in chunk:
+                    if misses >= max_misses:
                         return
+                    if task_id in pull_pending:
+                        skipped += 1    # args still in flight this pass
+                        continue
+                    rec = self.task_manager.get(task_id)
+                    if rec is None or rec.done:
+                        with self._cv:
+                            try:
+                                self._local_queue.remove(task_id)
+                            except ValueError:
+                                continue    # concurrent cancel removed it
+                            self._local_since.pop(task_id, None)
+                            self._env_miss_since.pop(task_id, None)
+                            if rec is not None:
+                                self._planned_add(rec.spec.resources, -1)
+                        continue
+                    spec = rec.spec
+                    # reserve resources BEFORE popping a worker
+                    # (pool.release fires the idle wake-up, so a
+                    # speculative pop-then-release would spin the loop)
+                    if not self.crm.subtract(self.row, spec.resources):
+                        if not kicked:
+                            # resource-starved backlog = autoscaler demand
+                            asc = getattr(self.cluster, "autoscaler", None)
+                            if asc is not None:
+                                asc.kick()
+                            kicked = True
+                        misses += 1
+                        class_full = True
+                        break           # rest of the bucket cannot fit
+                    outcome = self._drain_try_worker(task_id, rec, spec,
+                                                     env_missed)
+                    if outcome == "stop":
+                        return
+                    if outcome == "ok":
+                        continue        # entry removed from the bucket
+                    if outcome == "miss":
+                        misses += 1
+                    skipped += 1        # miss/skip leave the entry queued
+                    # (over-counts when a helper removed the entry — a
+                    # later pass re-examines anything this one missed)
+        return
+
+    def _drain_try_worker(self, task_id, rec, spec,
+                          env_missed: set) -> str:
+        """Second half of one drain step: lease a worker (env-keyed or
+        default, else a pipelined commit) and dispatch.  Outcomes:
+        ``"ok"`` dispatched/committed, ``"miss"`` count against the miss
+        budget, ``"skip"`` no budget charge (env miss already counted,
+        concurrent removal), ``"stop"`` end the whole pass
+        (worker-limited)."""
+        if spec.runtime_env:
+            worker, env_k = self._pop_env_worker(task_id, rec, spec)
+            if worker is None:
+                # one miss per env KEY per scan (like a full class's
+                # single miss for resources): a block of same-env tasks
+                # parked at a barrier must not eat the whole miss budget
+                # and starve runnable default tasks behind them
+                if env_k is None or env_k not in env_missed:
+                    if env_k is not None:
+                        env_missed.add(env_k)
+                    return "miss"   # waits for its env worker (or
+                #                     failed staging); others may still
+                return "skip"       # dispatch
+        else:
+            worker = self.pool.pop_idle()
+            if worker is None:
+                # pipelined lease: commit the task to a BUSY worker's
+                # soft queue (resources stay debited); the exec frame
+                # ships the instant that worker's current result
+                # lands, cutting the result->rescan->dispatch round
+                # trip out of the tiny-task critical path
+                depth = get_config().worker_pipeline_depth
+                target = self.pool.pipeline_target(None, depth) \
+                    if depth > 1 else None
+                if target is not None:
+                    committed = False
+                    with self._cv:
+                        # re-validate AT COMMIT: the target may have
+                        # died/blocked/been released since selection
+                        # (the reconcile sweep covers what still
+                        # slips through this non-atomic check)
+                        if not target.dead and not target.blocked \
+                                and target.leased_task is not None:
+                            try:
+                                self._local_queue.remove(task_id)
+                            except ValueError:
+                                self.crm.add_back(self.row,
+                                                  spec.resources)
+                                return "skip"
+                            self._local_since.pop(task_id, None)
+                            self._env_miss_since.pop(task_id, None)
+                            self._planned_add(spec.resources, -1)
+                            target.assigned.append(
+                                (task_id, time.monotonic()))
+                            self._assigned_total += 1
+                            committed = True
+                    if committed:
+                        return "ok"
                     self.crm.add_back(self.row, spec.resources)
-                    # worker-limited: park, but tasks that waited past the
-                    # lease timeout spill back to global placement
                     self._spill_stale_leases()
-                    return
-            with self._cv:
-                try:
-                    self._local_queue.remove(task_id)
-                except ValueError:
-                    self.crm.add_back(self.row, spec.resources)
-                    self.pool.release(worker)
-                    continue
-                self._local_since.pop(task_id, None)
-                self._env_miss_since.pop(task_id, None)
-                self._planned_add(spec.resources, -1)
-            self._dispatch(worker, rec)
+                    return "stop"
+                self.crm.add_back(self.row, spec.resources)
+                # worker-limited: park, but tasks that waited past the
+                # lease timeout spill back to global placement
+                self._spill_stale_leases()
+                return "stop"
+        with self._cv:
+            try:
+                self._local_queue.remove(task_id)
+            except ValueError:
+                self.crm.add_back(self.row, spec.resources)
+                self.pool.release(worker)
+                return "skip"
+            self._local_since.pop(task_id, None)
+            self._env_miss_since.pop(task_id, None)
+            self._planned_add(spec.resources, -1)
+        self._dispatch(worker, rec)
+        return "ok"
 
     def _dispatch(self, worker: WorkerHandle, rec) -> bool:
         spec = rec.spec
@@ -1041,7 +1145,8 @@ class Raylet:
                 self._enqueue(task_id)
             else:
                 with self._cv:
-                    self._local_queue.append(task_id)
+                    self._local_queue.append(task_id,
+                                             rec.spec.resources.key())
                     self._local_since[task_id] = time.monotonic()
                     self._planned_add(rec.spec.resources, 1)
         if spill:

@@ -46,7 +46,14 @@ class ReferenceCounter:
 
     def decref(self, object_id: ObjectID) -> None:
         self._events.append((-1, object_id))
-        self._wake.set()
+        # wake on the empty->non-empty transition or a deep backlog: a
+        # burst of dying refs (tiny-task storms) must not ping-pong the
+        # GIL between this thread and the reclaimer once per event, and
+        # an idle process must not poll; the periodic sweep bounds the
+        # latency of events that race a concurrent flush
+        n = len(self._events)
+        if n == 1 or n >= 256:
+            self._wake.set()
 
     # -- pinning (PG ready markers etc. are never reclaimed) -----------------
     def pin(self, object_id: ObjectID) -> None:
