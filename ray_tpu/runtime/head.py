@@ -95,6 +95,7 @@ class HeadNode:
             "available_resources": self._available_resources,
             "cluster_resources": self._cluster_resources,
             "timeline": self._timeline,
+            "state_list": self._state_list,
             "memory": self._memory,
             "job_submit": self.jobs.submit,
             "job_status": self.jobs.status,
@@ -217,6 +218,22 @@ class HeadNode:
 
     def _timeline(self) -> list[dict]:
         return self._rt.cluster.events.timeline()
+
+    def _state_list(self, kind: str,
+                    filters: list | None = None) -> list[dict]:
+        """State-API rows for the CLI (reference: ``ray list tasks`` et
+        al. resolve through the head's state aggregator)."""
+        from ..util import state
+        table = {"tasks": state.list_tasks,
+                 "actors": state.list_actors,
+                 "objects": state.list_objects,
+                 "nodes": state.list_nodes,
+                 "placement-groups": state.list_placement_groups}
+        fn = table.get(kind)
+        if fn is None:
+            raise ValueError(
+                f"unknown state kind {kind!r} (one of {sorted(table)})")
+        return fn([tuple(f) for f in filters] if filters else None)
 
     def _memory(self) -> dict:
         return self._rt.cluster.store.stats()

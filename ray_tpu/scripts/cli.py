@@ -199,6 +199,37 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_list(args) -> int:
+    """``ray_tpu list tasks|actors|objects|nodes|placement-groups``
+    (reference: the ``ray list`` state CLI)."""
+    filters = []
+    for f in args.filter or []:
+        if "=" not in f:
+            raise SystemExit(f"--filter needs key=value, got {f!r}")
+        k, v = f.split("=", 1)
+        filters.append((k, "=", v))
+    client = _client(args.address)
+    try:
+        rows = client.call("state_list", args.kind, filters or None,
+                           timeout=30.0)
+    finally:
+        client.close()
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print(f"no {args.kind}")
+        return 0
+    columns = list(rows[0])
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+    return 0
+
+
 def cmd_memory(args) -> int:
     client = _client(args.address)
     try:
@@ -357,6 +388,17 @@ def build_parser() -> argparse.ArgumentParser:
     pq = sub.add_parser("status", help="cluster status")
     pq.add_argument("--address", default=None)
     pq.set_defaults(fn=cmd_status)
+
+    pl = sub.add_parser("list", help="list live cluster state")
+    pl.add_argument("kind", choices=["tasks", "actors", "objects",
+                                     "nodes", "placement-groups"])
+    pl.add_argument("--filter", action="append", default=None,
+                    metavar="KEY=VALUE",
+                    help="equality filter, repeatable")
+    pl.add_argument("--format", choices=["table", "json"],
+                    default="table")
+    pl.add_argument("--address", default=None)
+    pl.set_defaults(fn=cmd_list)
 
     pm = sub.add_parser("memory", help="object store stats")
     pm.add_argument("--address", default=None)

@@ -31,7 +31,11 @@ def _apply_filters(rows: list[dict],
     for key, op, value in filters:
         if op not in ("=", "=="):
             raise ValueError(f"unsupported filter op {op!r}")
-        rows = [r for r in rows if r.get(key) == value]
+        # string-coerced fallback: CLI filters arrive as strings, so
+        # `--filter row=0` must match the int field (the reference's
+        # state CLI compares string forms the same way)
+        rows = [r for r in rows
+                if r.get(key) == value or str(r.get(key)) == str(value)]
     return rows
 
 

@@ -233,3 +233,57 @@ class TestCli:
         finally:
             r = cli("stop")
             assert r.returncode == 0, r.stderr
+
+
+class TestStateListCli:
+    def test_list_kinds_filters_and_formats(self, head, capsys):
+        import json as _json
+
+        import ray_tpu
+        from ray_tpu.scripts.cli import main
+
+        @ray_tpu.remote
+        class Listed:
+            def ping(self):
+                return "pong"
+
+        a = Listed.options(name="list_me").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        try:
+            assert main(["list", "actors",
+                         "--address", head.address]) == 0
+            out = capsys.readouterr().out
+            assert "list_me" in out and "actor_id" in out
+
+            assert main(["list", "nodes", "--format", "json",
+                         "--address", head.address]) == 0
+            rows = _json.loads(capsys.readouterr().out)
+            assert len(rows) == 1 and rows[0]["state"] == "ALIVE"
+
+            assert main(["list", "actors", "--filter", "name=list_me",
+                         "--address", head.address]) == 0
+            assert "list_me" in capsys.readouterr().out
+            assert main(["list", "actors", "--filter", "name=absent",
+                         "--address", head.address]) == 0
+            assert "no actors" in capsys.readouterr().out
+
+            # string-coerced filter matches typed fields (row is int)
+            assert main(["list", "nodes", "--filter", "row=0",
+                         "--format", "json",
+                         "--address", head.address]) == 0
+            import json as _json2
+            assert len(_json2.loads(capsys.readouterr().out)) == 1
+
+            assert main(["list", "tasks",
+                         "--address", head.address]) == 0
+            assert main(["list", "placement-groups",
+                         "--address", head.address]) == 0
+            capsys.readouterr()
+
+            with pytest.raises(SystemExit):
+                main(["list", "gizmos", "--address", head.address])
+            with pytest.raises(SystemExit, match="key=value"):
+                main(["list", "actors", "--filter", "bogus",
+                      "--address", head.address])
+        finally:
+            ray_tpu.kill(a)
