@@ -116,6 +116,26 @@ class ASHAScheduler:
 
 
 @dataclass
+class PopulationBasedTraining:
+    """PBT (reference ``tune.schedulers.PopulationBasedTraining``): the
+    population trains in intervals; after each interval the bottom
+    quantile EXPLOITS a top-quantile peer (copies its checkpoint and
+    config) and EXPLORES by mutating the listed hyperparameters —
+    resample from the domain with ``resample_probability``, else scale
+    numeric values by 0.8/1.2 (the reference's perturbation factors).
+
+    ``hyperparam_mutations``: name -> ``Domain`` / list of choices.
+    Total iterations = ``perturbation_interval * num_intervals``, the
+    same cumulative ``tune_iterations`` contract as ASHA."""
+
+    perturbation_interval: int = 1
+    num_intervals: int = 4
+    quantile_fraction: float = 0.25
+    resample_probability: float = 0.25
+    hyperparam_mutations: dict = field(default_factory=dict)
+
+
+@dataclass
 class TuneConfig:
     metric: str = "loss"
     mode: str = "min"
@@ -143,6 +163,8 @@ class Tuner:
         sched = self._cfg.scheduler
         if isinstance(sched, ASHAScheduler):
             results = self._fit_asha(fn_bytes, configs, sched, timeout)
+        elif isinstance(sched, PopulationBasedTraining):
+            results = self._fit_pbt(fn_bytes, configs, sched, timeout)
         else:
             results = self._fit_fifo(fn_bytes, configs, timeout)
         return ResultGrid(results, self._cfg.metric, self._cfg.mode)
@@ -163,32 +185,40 @@ class Tuner:
         return [self._result(cfg, reports, state)
                 for cfg, (reports, state) in zip(configs, outs)]
 
+    @staticmethod
+    def _run_round(task, fn_bytes, trials, budget, timeout) -> None:
+        """One synchronized round: every trial resumes from its
+        checkpoint, runs to ``budget`` TOTAL iterations, and folds its
+        reports/checkpoint back in (shared by ASHA rungs and PBT
+        intervals)."""
+        import ray_tpu
+        refs = []
+        for trial in trials:
+            cfg = dict(trial.config)
+            cfg["tune_iterations"] = budget
+            state = trial.checkpoint.to_dict() \
+                if trial.checkpoint is not None else None
+            refs.append(task.remote(fn_bytes, cfg, state))
+        outs = ray_tpu.get(refs, timeout=timeout)
+        for trial, (reports, state) in zip(trials, outs):
+            trial.history.extend(reports)
+            if reports:
+                trial.metrics = reports[-1]
+            if state is not None:
+                trial.checkpoint = Checkpoint(state)
+
     def _fit_asha(self, fn_bytes, configs, sched,
                   timeout) -> list[TrialResult]:
         """Rung r: survivors run ``grace*eta**r`` TOTAL iterations
         (resumed from their previous rung's checkpoint via
         ``tune.get_checkpoint``); the top 1/eta promote."""
-        import ray_tpu
         metric, mode = self._cfg.metric, self._cfg.mode
         task = self._task()
         alive = [TrialResult(dict(cfg), {}, [], None) for cfg in configs]
         finished: list[TrialResult] = []
         budget = min(sched.grace_period, sched.max_t)
         while alive:
-            refs = []
-            for trial in alive:
-                cfg = dict(trial.config)
-                cfg["tune_iterations"] = budget
-                state = trial.checkpoint.to_dict() \
-                    if trial.checkpoint is not None else None
-                refs.append(task.remote(fn_bytes, cfg, state))
-            outs = ray_tpu.get(refs, timeout=timeout)
-            for trial, (reports, state) in zip(alive, outs):
-                trial.history.extend(reports)
-                if reports:
-                    trial.metrics = reports[-1]
-                if state is not None:
-                    trial.checkpoint = Checkpoint(state)
+            self._run_round(task, fn_bytes, alive, budget, timeout)
             if budget >= sched.max_t:
                 finished.extend(alive)      # final rung ran at max_t
                 break
@@ -206,6 +236,78 @@ class Tuner:
             # always runs the full budget
             budget = min(budget * sched.reduction_factor, sched.max_t)
         return finished + [t for t in alive if t not in finished]
+
+    def _fit_pbt(self, fn_bytes, configs, sched,
+                 timeout) -> list[TrialResult]:
+        """Interval k: every trial resumes from its checkpoint and runs
+        to ``perturbation_interval * k`` total iterations; then the
+        bottom quantile exploits + explores (see scheduler docstring)."""
+        import numpy as np
+        if not 0.0 < sched.quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]: "
+                             f"{sched.quantile_fraction}")
+        metric, mode = self._cfg.metric, self._cfg.mode
+        task = self._task()
+        pop = [TrialResult(dict(cfg), {}, [], None) for cfg in configs]
+        for k in range(1, sched.num_intervals + 1):
+            self._run_round(task, fn_bytes, pop,
+                            sched.perturbation_interval * k, timeout)
+            if k == sched.num_intervals:
+                continue
+            # quantiles over the trials that actually REPORTED, sized so
+            # top and bottom never overlap (an overlap would exploit a
+            # well-performing trial with its own mutated copy)
+            scored = [t for t in pop if metric in t.metrics]
+            q = max(1, int(len(scored) * sched.quantile_fraction))
+            if len(scored) < 2 * q or len(scored) < 2:
+                continue
+            scored.sort(key=lambda t: t.metrics[metric],
+                        reverse=(mode == "max"))
+            top, bottom = scored[:q], scored[-q:]
+            rng = np.random.default_rng(self._cfg.seed * 1000 + k)
+            for trial in bottom:
+                peer = top[int(rng.integers(len(top)))]
+                # exploit: the peer's weights and hyperparameters
+                trial.checkpoint = peer.checkpoint
+                trial.config = self._explore(dict(peer.config), sched,
+                                             rng)
+        return pop
+
+    @staticmethod
+    def _explore(config: dict, sched, rng) -> dict:
+        """Mutate the listed hyperparameters of an exploited config.
+        Continuous domains perturb by 0.8/1.2 (or resample); list
+        domains step to an ADJACENT entry (or resample) — a perturbed
+        value must stay inside the candidate set, the reference's PBT
+        list-mutation rule."""
+        from .search import Domain
+        for name, domain in sched.hyperparam_mutations.items():
+            if name not in config:
+                continue
+            resample = rng.random() < sched.resample_probability
+            if isinstance(domain, Domain):
+                if resample:
+                    config[name] = domain.sample(rng)
+                    continue
+            elif isinstance(domain, (list, tuple)):
+                choices = list(domain)
+                cur = config[name]
+                if resample or cur not in choices:
+                    config[name] = choices[int(rng.integers(
+                        len(choices)))]
+                else:
+                    i = choices.index(cur)
+                    step = 1 if rng.random() < 0.5 else -1
+                    config[name] = choices[min(max(i + step, 0),
+                                               len(choices) - 1)]
+                continue
+            value = config[name]
+            if isinstance(value, (int, float)) and \
+                    not isinstance(value, bool):
+                factor = 1.2 if rng.random() < 0.5 else 0.8
+                config[name] = type(value)(value * factor) \
+                    if isinstance(value, int) else value * factor
+        return config
 
     @staticmethod
     def _result(cfg, reports, state) -> TrialResult:

@@ -124,3 +124,89 @@ class TestAsha:
         lr = best.config["lr"]
         expect = 10.0 * (1 - 2 * lr) ** 8
         np.testing.assert_allclose(x, expect, rtol=1e-10)
+
+
+class TestPbt:
+    def test_population_converges_via_exploit(self):
+        """Gradient descent on (x-3)^2: half the population starts with
+        a uselessly small lr.  PBT must copy the good trials' weights +
+        lr into the stragglers, so EVERY member ends near the optimum —
+        without exploitation the bad-lr trials cannot get there."""
+        def trainable(config):
+            ckpt = tune.get_checkpoint()
+            state = ckpt.to_dict() if ckpt is not None else \
+                {"x": 0.0, "it": 0}
+            x, it = state["x"], state["it"]
+            for i in range(it, config["tune_iterations"]):
+                x -= config["lr"] * 2 * (x - 3.0)
+                tune.report(
+                    {"loss": (x - 3.0) ** 2},
+                    checkpoint=tune.Checkpoint({"x": x, "it": i + 1}))
+
+        grid = tune.Tuner(
+            trainable,
+            param_space={"lr": tune.grid_search(
+                [1e-6, 1e-6, 0.3, 0.3])},
+            tune_config=tune.TuneConfig(
+                metric="loss", mode="min",
+                scheduler=tune.PopulationBasedTraining(
+                    perturbation_interval=4, num_intervals=4,
+                    quantile_fraction=0.25,
+                    hyperparam_mutations={
+                        "lr": tune.loguniform(1e-2, 1.0)}),
+            )).fit(timeout=300)
+        losses = sorted(r.metrics["loss"] for r in grid)
+        # with lr=1e-6 and 16 iterations, x stays ~0 -> loss ~9; every
+        # exploited trial restarts from a good peer's x instead
+        assert losses[0] < 1e-3
+        assert sum(l < 1.0 for l in losses) >= 3, losses
+        best = grid.get_best_result()
+        assert best.metrics["loss"] < 1e-3
+
+    def test_explore_mutates_only_listed_params(self):
+        import numpy as np
+        from ray_tpu.tune.tuner import PopulationBasedTraining, Tuner
+        sched = PopulationBasedTraining(
+            resample_probability=0.0,
+            hyperparam_mutations={"lr": tune.loguniform(1e-4, 1.0),
+                                  "mode": ["a", "b"]})
+        rng = np.random.default_rng(0)
+        cfg = Tuner._explore({"lr": 0.1, "mode": "a", "frozen": 5},
+                             sched, rng)
+        assert cfg["frozen"] == 5
+        assert cfg["lr"] in (pytest.approx(0.08), pytest.approx(0.12))
+        assert cfg["mode"] in ("a", "b")
+
+    def test_explore_resamples_from_domain(self):
+        import numpy as np
+        from ray_tpu.tune.tuner import PopulationBasedTraining, Tuner
+        sched = PopulationBasedTraining(
+            resample_probability=1.0,
+            hyperparam_mutations={"lr": tune.uniform(10.0, 20.0)})
+        rng = np.random.default_rng(1)
+        cfg = Tuner._explore({"lr": 0.1}, sched, rng)
+        assert 10.0 <= cfg["lr"] <= 20.0
+
+    def test_explore_list_mutation_stays_in_candidates(self):
+        import numpy as np
+        from ray_tpu.tune.tuner import PopulationBasedTraining, Tuner
+        sched = PopulationBasedTraining(
+            resample_probability=0.0,
+            hyperparam_mutations={"bs": [16, 32, 64]})
+        for seed in range(6):
+            cfg = Tuner._explore({"bs": 32}, sched,
+                                 np.random.default_rng(seed))
+            assert cfg["bs"] in (16, 64)    # adjacent, never 38
+        # edge entries clamp instead of escaping the list
+        for seed in range(6):
+            cfg = Tuner._explore({"bs": 16}, sched,
+                                 np.random.default_rng(seed))
+            assert cfg["bs"] in (16, 32)
+
+    def test_quantile_fraction_validated(self):
+        with pytest.raises(ValueError, match="quantile_fraction"):
+            tune.Tuner(
+                lambda cfg: None, param_space={"x": 1},
+                tune_config=tune.TuneConfig(
+                    scheduler=tune.PopulationBasedTraining(
+                        quantile_fraction=0.8))).fit(timeout=30)
