@@ -9,11 +9,12 @@ mount empty).
 
 TPU-first: rollouts are Python-on-actors (environment stepping is
 host-bound everywhere), but the POLICY and its update are one jitted
-JAX program — softmax policy gradient with baseline, batched over all
-collected episodes — so the math rides the compiler, and the same
-update shards over a mesh the way ``train.MeshTrainer`` does.
+JAX program — softmax policy gradient with baseline, or PPO's clipped
+surrogate with GAE and a value head, batched over all collected
+episodes — so the math rides the compiler, and the same update shards
+over a mesh the way ``train.MeshTrainer`` does.
 """
 
-from .algorithm import Algorithm, PGConfig, RolloutWorker
+from .algorithm import PPO, Algorithm, PGConfig, PPOConfig, RolloutWorker
 
-__all__ = ["Algorithm", "PGConfig", "RolloutWorker"]
+__all__ = ["Algorithm", "PGConfig", "PPO", "PPOConfig", "RolloutWorker"]

@@ -118,22 +118,38 @@ class Algorithm:
 
         return update
 
-    def train(self) -> dict:
-        """One iteration: parallel rollouts -> batched PG update."""
+    def _collect_episodes(self, policy_params: dict) -> tuple:
+        """Parallel rollouts: broadcast the policy, gather episodes.
+        Returns ``(episodes, ep_rewards)``."""
         import ray_tpu
         cfg = self.config
-        params = {k: np.asarray(v) for k, v in self._params.items()}
         batches = ray_tpu.get(
-            [w.sample.remote(params, cfg.episodes_per_worker,
+            [w.sample.remote(policy_params, cfg.episodes_per_worker,
                              cfg.horizon) for w in self._workers],
             timeout=300)
         episodes = [ep for b in batches for ep in b]
+        return episodes, [float(ep["rewards"].sum()) for ep in episodes]
+
+    def _iter_metrics(self, episodes, ep_rewards, n_steps: int,
+                      **extra) -> dict:
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "episodes_this_iter": len(episodes),
+                "timesteps_this_iter": int(n_steps),
+                "episode_reward_mean": float(np.mean(ep_rewards)),
+                "episode_reward_max": float(np.max(ep_rewards)),
+                "episode_reward_min": float(np.min(ep_rewards)),
+                **extra}
+
+    def train(self) -> dict:
+        """One iteration: parallel rollouts -> batched PG update."""
+        cfg = self.config
+        params = {k: np.asarray(v) for k, v in self._params.items()}
+        episodes, ep_rewards = self._collect_episodes(params)
         # flatten all timesteps; per-step discounted return-to-go
         obs, acts, rets = [], [], []
-        ep_rewards = []
         for ep in episodes:
             r = ep["rewards"]
-            ep_rewards.append(float(r.sum()))
             g = np.zeros_like(r)
             acc = 0.0
             for t in range(len(r) - 1, -1, -1):
@@ -147,13 +163,7 @@ class Algorithm:
         rets = np.concatenate(rets).astype(np.float32)
         mask = np.ones(len(rets), dtype=np.float32)
         self._params = self._update(self._params, obs, acts, rets, mask)
-        self.iteration += 1
-        return {"training_iteration": self.iteration,
-                "episodes_this_iter": len(episodes),
-                "timesteps_this_iter": int(len(rets)),
-                "episode_reward_mean": float(np.mean(ep_rewards)),
-                "episode_reward_max": float(np.max(ep_rewards)),
-                "episode_reward_min": float(np.min(ep_rewards))}
+        return self._iter_metrics(episodes, ep_rewards, len(rets))
 
     def get_policy_params(self) -> dict:
         return {k: np.asarray(v) for k, v in self._params.items()}
@@ -170,3 +180,140 @@ class Algorithm:
         for w in self._workers:
             ray_tpu.kill(w)
         self._workers = []
+
+
+# ---------------------------------------------------------------------------
+# PPO
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PPOConfig(PGConfig):
+    """Reference ``PPOConfig`` essentials: clipped surrogate objective,
+    GAE advantages, a linear value head, multi-epoch minibatch SGD over
+    each iteration's batch."""
+
+    clip_param: float = 0.2
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+    gae_lambda: float = 0.95
+
+
+def _value(params, obs):
+    return obs @ params["vw"] + params["vb"]
+
+
+class PPO(Algorithm):
+    """Proximal Policy Optimization on the shared rollout plane.
+
+    Rollout workers are identical to PG's (they only need the softmax
+    policy weights); the learner recomputes behavior log-probs from the
+    unchanged sampling params, builds GAE advantages from its value
+    head, then runs clipped-surrogate minibatch epochs as one jitted
+    step per minibatch (reference ``rllib/algorithms/ppo``)."""
+
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)
+        self._params = dict(self._params)
+        self._params["vw"] = np.zeros(config.obs_dim, dtype=np.float32)
+        self._params["vb"] = np.float32(0.0)
+        import jax
+        self._ppo_step = jax.jit(self._make_ppo_step())
+
+    @staticmethod
+    def _logp_host(params, obs, actions):
+        """Behavior log-probs on HOST numpy: the full-batch shape varies
+        per iteration, so a jitted version would recompile every
+        train() call."""
+        logits = obs @ params["w"] + params["b"]
+        z = logits - logits.max(axis=1, keepdims=True)
+        lp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+        return lp[np.arange(len(actions)), actions].astype(np.float32)
+
+    def _make_ppo_step(self):
+        import jax
+        import jax.numpy as jnp
+        cfg = self.config
+
+        def step(params, obs, actions, logp_old, adv, vtarg):
+            def loss_fn(p):
+                lp_all = jax.nn.log_softmax(_softmax_logits(p, obs))
+                lp = jnp.take_along_axis(lp_all, actions[:, None],
+                                         axis=1)[:, 0]
+                ratio = jnp.exp(lp - logp_old)
+                clipped = jnp.clip(ratio, 1 - cfg.clip_param,
+                                   1 + cfg.clip_param)
+                policy_loss = -jnp.mean(
+                    jnp.minimum(ratio * adv, clipped * adv))
+                v = _value(p, obs)
+                value_loss = jnp.mean((v - vtarg) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(lp_all) * lp_all, axis=1))
+                return (policy_loss + cfg.vf_coef * value_loss
+                        - cfg.entropy_coef * entropy), \
+                    (policy_loss, value_loss)
+
+            grads, (pl, vl) = jax.grad(loss_fn, has_aux=True)(params)
+            new = jax.tree_util.tree_map(
+                lambda p, g: p - cfg.lr * g, params, grads)
+            return new, pl, vl
+        return step
+
+    def train(self) -> dict:
+        cfg = self.config
+        policy = {k: np.asarray(v) for k, v in self._params.items()
+                  if k in ("w", "b")}
+        episodes, ep_rewards = self._collect_episodes(policy)
+        host = {k: np.asarray(v) for k, v in self._params.items()}
+        obs_l, act_l, adv_l, vt_l = [], [], [], []
+        for ep in episodes:
+            o, r = ep["obs"], ep["rewards"]
+            v = np.asarray(_value(host, o), dtype=np.float32)
+            # GAE(λ): delta_t = r_t + γV(s_{t+1}) - V(s_t), terminal
+            # bootstrap 0 (episodes end by done or horizon truncation —
+            # truncation bootstrapping is a known simplification)
+            v_next = np.append(v[1:], 0.0).astype(np.float32)
+            delta = r + cfg.gamma * v_next - v
+            adv = np.zeros_like(r)
+            acc = 0.0
+            for t in range(len(r) - 1, -1, -1):
+                acc = delta[t] + cfg.gamma * cfg.gae_lambda * acc
+                adv[t] = acc
+            obs_l.append(o)
+            act_l.append(ep["actions"])
+            adv_l.append(adv)
+            vt_l.append(adv + v)            # value targets
+        obs = np.concatenate(obs_l)
+        acts = np.concatenate(act_l)
+        adv = np.concatenate(adv_l).astype(np.float32)
+        vtarg = np.concatenate(vt_l).astype(np.float32)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        logp_old = self._logp_host(policy, obs, acts)
+        n = len(acts)
+        mbs = cfg.minibatch_size
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        pls, vls = [], []
+        for _ in range(cfg.num_epochs):
+            # fixed minibatch shape = one XLA compilation: full batches
+            # from a permutation, remainder refilled by re-sampling (or
+            # the whole batch bootstrapped when it is smaller than mbs)
+            if n >= mbs:
+                order = rng.permutation(n)
+                starts = range(0, n - n % mbs, mbs)
+                batches = [order[lo:lo + mbs] for lo in starts]
+                if n % mbs:
+                    batches.append(rng.choice(n, size=mbs,
+                                              replace=False))
+            else:
+                batches = [rng.choice(n, size=mbs, replace=True)]
+            for idx in batches:
+                self._params, pl, vl = self._ppo_step(
+                    self._params, obs[idx], acts[idx], logp_old[idx],
+                    adv[idx], vtarg[idx])
+                pls.append(float(pl))
+                vls.append(float(vl))
+        return self._iter_metrics(
+            episodes, ep_rewards, n,
+            policy_loss=float(np.mean(pls)),
+            vf_loss=float(np.mean(vls)))
