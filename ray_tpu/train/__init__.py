@@ -10,7 +10,9 @@ Two trainers, both real:
 
 - **JaxTrainer** — the reference shape: N worker actors placed as a
   PACK gang, per-worker dataset shards, gradient allreduce over the
-  ``ray_tpu.util.collective`` process group.
+  ``ray_tpu.util.collective`` process group, and gang fault tolerance
+  (``FailureConfig``): on a worker death the gang restarts and resumes
+  from the checkpoint rank 0 persisted via ``train.report``.
 - **MeshTrainer** — the TPU-first shape: ONE process, N devices;
   the training step is compiled with ``shard_map`` over a
   ``jax.sharding.Mesh`` (batch sharded on the data axis, grads
@@ -20,8 +22,9 @@ Two trainers, both real:
 
 from .checkpoint import Checkpoint
 from .mesh import MeshTrainer
-from .trainer import (JaxTrainer, Result, ScalingConfig, get_context,
-                      report)
+from .trainer import (FailureConfig, JaxTrainer, Result, ScalingConfig,
+                      get_checkpoint, get_context, report)
 
-__all__ = ["Checkpoint", "JaxTrainer", "MeshTrainer", "Result",
-           "ScalingConfig", "get_context", "report"]
+__all__ = ["Checkpoint", "FailureConfig", "JaxTrainer", "MeshTrainer",
+           "Result", "ScalingConfig", "get_checkpoint", "get_context",
+           "report"]

@@ -29,6 +29,17 @@ class ScalingConfig:
 
 
 @dataclass
+class FailureConfig:
+    """Gang fault tolerance (reference ``train.FailureConfig``): on a
+    worker failure the whole gang restarts — up to ``max_failures``
+    times — from the latest checkpoint rank 0 persisted through
+    ``train.report(..., checkpoint=...)`` (the loop resumes it via
+    ``train.get_checkpoint()``)."""
+
+    max_failures: int = 0
+
+
+@dataclass
 class Result:
     metrics: dict[str, Any]
     checkpoint: Checkpoint | None
@@ -37,14 +48,23 @@ class Result:
 
 class TrainContext:
     def __init__(self, rank: int, world_size: int, group: str,
-                 shard, config: dict):
+                 shard, config: dict,
+                 checkpoint_in: Checkpoint | None = None,
+                 persist_key: str | None = None):
         self._rank = rank
         self._world = world_size
         self._group = group
         self._shard = shard
         self._config = config
+        self._persist_key = persist_key
+        self.checkpoint_in = checkpoint_in
         self.reports: list[dict] = []
         self.checkpoint: Checkpoint | None = None
+
+    def get_checkpoint(self) -> Checkpoint | None:
+        """The checkpoint to resume from (a prior attempt's persisted
+        state, or None on a fresh start)."""
+        return self.checkpoint_in
 
     def get_world_rank(self) -> int:
         return self._rank
@@ -88,6 +108,15 @@ class TrainContext:
         self.reports.append(dict(metrics))
         if checkpoint is not None:
             self.checkpoint = checkpoint
+            if self._rank == 0 and self._persist_key is not None:
+                # durable checkpoint (reference: report() uploads to
+                # storage) — a gang restart resumes from HERE, not from
+                # scratch; rank 0 only, like the reference's convention
+                from ..experimental.internal_kv import _internal_kv_put
+                from ..runtime.serialization import serialize
+                _internal_kv_put(self._persist_key,
+                                 serialize(checkpoint.to_dict()),
+                                 namespace="train")
 
 
 def get_context() -> TrainContext:
@@ -100,6 +129,12 @@ def get_context() -> TrainContext:
 def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
     """``ray_tpu.train.report`` — callable from inside the loop."""
     get_context().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    """``ray_tpu.train.get_checkpoint`` — the resume point after a gang
+    restart (reference: ``train.get_checkpoint()``)."""
+    return get_context().get_checkpoint()
 
 
 # -- tiny pytree (dict/list/tuple/leaf) --------------------------------------
@@ -135,12 +170,18 @@ class _TrainWorker:
     """One gang member: joins the collective group, runs the loop."""
 
     def run(self, fn_bytes: bytes, config: dict, rank: int,
-            world: int, group: str, shard_rows) -> tuple:
+            world: int, group: str, shard_rows,
+            ckpt_state: dict | None = None,
+            persist_key: str | None = None) -> tuple:
         from ..runtime.serialization import deserialize
         from ..util import collective as col
         col.init_collective_group(world, rank, group)
         try:
-            ctx = TrainContext(rank, world, group, shard_rows, config)
+            ctx = TrainContext(
+                rank, world, group, shard_rows, config,
+                checkpoint_in=(Checkpoint(ckpt_state)
+                               if ckpt_state is not None else None),
+                persist_key=persist_key)
             _ctx.value = ctx
             try:
                 deserialize(fn_bytes)(config)
@@ -157,21 +198,33 @@ class JaxTrainer:
     def __init__(self, train_loop_per_worker: Callable[[dict], None],
                  *, train_loop_config: dict | None = None,
                  scaling_config: ScalingConfig | None = None,
+                 failure_config: FailureConfig | None = None,
                  datasets: dict | None = None):
         self._fn = train_loop_per_worker
         self._config = dict(train_loop_config or {})
         self._scaling = scaling_config or ScalingConfig()
+        self._failure = failure_config or FailureConfig()
         self._datasets = dict(datasets or {})
 
     def fit(self, timeout: float = 300.0) -> Result:
+        """Run the gang to completion.  ``timeout`` is PER ATTEMPT: with
+        ``FailureConfig(max_failures=k)`` the worst-case wall time is
+        ``(k+1) * timeout`` plus placement; ``max_failures=-1`` retries
+        forever (the reference's infinite-retry value)."""
+        import logging
         import os
 
         import ray_tpu
-        from ..runtime.serialization import serialize
+        from ..experimental.internal_kv import (_internal_kv_del,
+                                                _internal_kv_get)
+        from ..runtime.serialization import deserialize, serialize
         from ..util.placement_group import (placement_group,
                                             remove_placement_group)
         n = self._scaling.num_workers
         res = self._scaling.resources_per_worker
+        # serialize BEFORE reserving anything: an unpicklable train
+        # loop must fail without leaking a placement group
+        fn_bytes = serialize(self._fn)
         # gang placement: all workers or none (reference: Train
         # reserves a PACK placement group before starting)
         pg = placement_group([dict(res)] * n, strategy="PACK")
@@ -180,7 +233,49 @@ class JaxTrainer:
         train_ds = self._datasets.get("train")
         if train_ds is not None:
             shards = [s.take_all() for s in train_ds.split(n)]
-        group = f"train-{os.urandom(4).hex()}"
+        run_id = os.urandom(4).hex()
+        persist_key = f"ckpt-{run_id}"
+        max_failures = self._failure.max_failures
+        attempt = 0
+        try:
+            while True:
+                raw = _internal_kv_get(persist_key, namespace="train")
+                ckpt_state = deserialize(raw) if raw is not None \
+                    else None
+                try:
+                    outs = self._run_gang(
+                        pg, fn_bytes, shards,
+                        f"train-{run_id}-a{attempt}", ckpt_state,
+                        persist_key, timeout)
+                    break
+                except Exception as e:  # noqa: BLE001 — worker/gang death
+                    if 0 <= max_failures <= attempt:
+                        raise
+                    attempt += 1
+                    # gang restart (reference FailureConfig): the next
+                    # attempt resumes from the persisted checkpoint
+                    logging.getLogger("ray_tpu.train").warning(
+                        "train gang attempt %d failed (%s: %s); "
+                        "restarting from the persisted checkpoint",
+                        attempt, type(e).__name__, e)
+        finally:
+            try:
+                _internal_kv_del(persist_key, namespace="train")
+            except Exception:   # noqa: BLE001 — a degraded KV must not
+                pass            # leak the PG or mask the gang error
+            remove_placement_group(pg)
+        rank0_reports, ckpt_state = outs[0]
+        return Result(
+            metrics=rank0_reports[-1] if rank0_reports else {},
+            checkpoint=Checkpoint(ckpt_state)
+            if ckpt_state is not None else None,
+            history=rank0_reports)
+
+    def _run_gang(self, pg, fn_bytes, shards, group,
+                  ckpt_state, persist_key, timeout) -> list:
+        import ray_tpu
+        n = self._scaling.num_workers
+        res = self._scaling.resources_per_worker
         worker_cls = ray_tpu.remote(_TrainWorker)
         actors: list = []
         try:
@@ -189,10 +284,10 @@ class JaxTrainer:
                 placement_group=pg,
                 placement_group_bundle_index=i).remote()
                 for i in range(n)]
-            fn_bytes = serialize(self._fn)
-            outs = ray_tpu.get(
+            return ray_tpu.get(
                 [a.run.remote(fn_bytes, self._config, i, n, group,
-                              shards[i]) for i, a in enumerate(actors)],
+                              shards[i], ckpt_state, persist_key)
+                 for i, a in enumerate(actors)],
                 timeout=timeout)
         finally:
             # kill in the FINALLY: a failed/timed-out gang must not
@@ -202,10 +297,3 @@ class JaxTrainer:
                     ray_tpu.kill(a)
                 except Exception:   # noqa: BLE001 — already dead
                     pass
-            remove_placement_group(pg)
-        rank0_reports, ckpt_state = outs[0]
-        return Result(
-            metrics=rank0_reports[-1] if rank0_reports else {},
-            checkpoint=Checkpoint(ckpt_state)
-            if ckpt_state is not None else None,
-            history=rank0_reports)

@@ -6,6 +6,8 @@ train.report metrics + checkpoints, Result; data-parallel gradient
 equivalence (SURVEY.md §1 layer 14, §2.4; scenarios re-derived, not
 copied)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -130,3 +132,57 @@ class TestMeshTrainer:
         assert np.isfinite(loss)
         with pytest.raises(ValueError, match="cannot shard"):
             t.step(np.ones((3, 2), dtype=np.float32))
+
+
+class TestFailureRecovery:
+    def test_gang_restarts_from_persisted_checkpoint(self):
+        """Rank 1 hard-crashes once at step 3 of 6; with
+        FailureConfig(max_failures=1) the gang restarts and resumes
+        from rank 0's persisted checkpoint instead of step 0."""
+        from ray_tpu import train
+
+        def loop(config):
+            import os as _os
+            ctx = train.get_context()
+            ckpt = train.get_checkpoint()
+            start = ckpt.to_dict()["step"] if ckpt is not None else 0
+            marker = config["marker"]
+            for step in range(start, 6):
+                if step == 3 and ctx.get_world_rank() == 1 \
+                        and not _os.path.exists(marker):
+                    open(marker, "w").close()
+                    _os._exit(1)        # hard worker death, once
+                vals = ctx.allreduce({"s": np.float32(step)}, op="mean")
+                train.report({"step": step, "sync": float(vals["s"]),
+                              "resumed_from": start},
+                             checkpoint=train.Checkpoint(
+                                 {"step": step + 1}))
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            marker = os.path.join(td, "crashed_once")
+            result = train.JaxTrainer(
+                loop,
+                train_loop_config={"marker": marker},
+                scaling_config=train.ScalingConfig(num_workers=2),
+                failure_config=train.FailureConfig(max_failures=1),
+            ).fit(timeout=240)
+            assert os.path.exists(marker)    # the crash DID happen
+        assert result.metrics["step"] == 5
+        assert result.metrics["sync"] == 5.0         # gang stayed in sync
+        assert result.metrics["resumed_from"] == 3   # NOT from scratch
+        assert result.checkpoint.to_dict() == {"step": 6}
+
+    def test_failures_exhausted_raises(self):
+        from ray_tpu import train
+
+        def always_dies(config):
+            import os as _os
+            _os._exit(1)
+
+        with pytest.raises(Exception):
+            train.JaxTrainer(
+                always_dies,
+                scaling_config=train.ScalingConfig(num_workers=2),
+                failure_config=train.FailureConfig(max_failures=1),
+            ).fit(timeout=120)
