@@ -40,7 +40,8 @@ def export(name: str | None = None):
             wrapped = obj
         with _lock:
             existing = _exports.get(export_name)
-            if existing is not None and existing is not wrapped:
+            if existing is not None and existing is not wrapped \
+                    and not _same_descriptor(existing, wrapped):
                 raise ValueError(
                     f"cross-language export {export_name!r} already "
                     "registered")
@@ -61,6 +62,26 @@ def _default_name(obj) -> str:
     inner = getattr(obj, "_fn", None) or getattr(obj, "_cls", None) or obj
     return getattr(inner, "__name__", None) or \
         getattr(obj, "_name", None) or repr(obj)
+
+
+def _same_descriptor(a, b) -> bool:
+    """Re-registration of the SAME underlying function/class is
+    idempotent: each decorator pass builds a fresh wrapper, so module
+    re-import / notebook re-run would otherwise always collide."""
+    def descriptor(obj):
+        inner = getattr(obj, "_fn", None) or getattr(obj, "_cls", None)
+        if inner is None:
+            return None
+        qn = getattr(inner, "__qualname__", None)
+        if qn is None or "<locals>" in qn or "<lambda>" in qn:
+            # factory closures / lambdas share a qualname while being
+            # genuinely different functions — keep the strict collision
+            # guard for them; only module/class-level names (what a
+            # re-import recreates) are idempotent
+            return None
+        return (getattr(inner, "__module__", None), qn)
+    da, db = descriptor(a), descriptor(b)
+    return da is not None and da == db
 
 
 def lookup(name: str):

@@ -700,7 +700,6 @@ class Raylet:
                     (self._local_since.get(t, float("inf"))
                      for t in islice(self._local_queue.bucket(k), 1)),
                     default=float("inf")))
-            pull_pending = set(self._pull_pending)
         for key in class_keys:
             # buckets snapshot in CHUNKS: a class that cannot fit stops
             # after one chunk, so a 100k-deep starved backlog costs a
@@ -713,6 +712,13 @@ class Raylet:
                 with self._cv:
                     chunk = list(islice(self._local_queue.bucket(key),
                                         skipped, skipped + chunk_size))
+                    # pull state snapshotted WITH the chunk: enqueue sets
+                    # _pull_pending in the same _cv section as the queue
+                    # append, so a task enqueued mid-pass with in-flight
+                    # pulls cannot appear in a chunk without its entry
+                    # (intersect with the chunk — O(chunk), not O(pending))
+                    pull_pending = {t for t in chunk
+                                    if t in self._pull_pending}
                 if not chunk:
                     break
                 for task_id in chunk:

@@ -280,22 +280,24 @@ _ingress_lock = threading.Lock()
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 0,
-          request_timeout_s: float = 30.0) -> str:
+          request_timeout_s: float = 30.0,
+          max_body_bytes: int = 64 * 1024 * 1024) -> str:
     """Start the HTTP ingress (idempotent); returns its address.
     ``http_port=0`` binds an ephemeral port — pass 8000 for the
     reference's fixed default."""
     return _ensure_ingress(http_host, http_port,
-                           request_timeout_s).address
+                           request_timeout_s, max_body_bytes).address
 
 
 def _ensure_ingress(http_host: str = "127.0.0.1", http_port: int = 0,
-                    request_timeout_s: float = 30.0):
+                    request_timeout_s: float = 30.0,
+                    max_body_bytes: int = 64 * 1024 * 1024):
     global _ingress
     from .http_proxy import HttpIngress
     with _ingress_lock:
         if _ingress is None:
             _ingress = HttpIngress(http_host, http_port,
-                                   request_timeout_s)
+                                   request_timeout_s, max_body_bytes)
         return _ingress
 
 

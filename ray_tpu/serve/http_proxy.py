@@ -40,10 +40,12 @@ class HttpIngress(BackgroundHTTPServer):
     allowed_methods = ("GET", "POST", "PUT", "DELETE")
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 max_body_bytes: int = 64 * 1024 * 1024):
         self._routes: dict[str, object] = {}    # prefix -> handle
         self._rlock = threading.Lock()
         self._timeout = request_timeout_s
+        self._max_body = max_body_bytes
         super().__init__(host=host, port=port, name="serve-http")
 
     @property
@@ -84,7 +86,27 @@ class HttpIngress(BackgroundHTTPServer):
                  "routes": self.routes()}).encode(),
                 "application/json", status=404)
             return
-        n = int(request.headers.get("Content-Length") or 0)
+        try:
+            n = int(request.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = -1
+        if n < 0:
+            # malformed/negative Content-Length: read(-1) would buffer
+            # the stream until EOF — refuse instead
+            self.reply(request, json.dumps(
+                {"error": "BadRequest",
+                 "message": "missing or malformed Content-Length"}
+                ).encode(), "application/json", status=400)
+            return
+        if n > self._max_body:
+            # refuse before allocating: an oversized Content-Length must
+            # not allocate in the ingress process before the handler runs
+            self.reply(request, json.dumps(
+                {"error": "PayloadTooLarge",
+                 "message": f"body of {n} bytes exceeds the ingress "
+                            f"limit of {self._max_body}"}).encode(),
+                "application/json", status=413)
+            return
         body = request.rfile.read(n) if n else b""
         req = HTTPRequest(method=request.command, path=path,
                           query=dict(parse_qsl(parts.query)), body=body)

@@ -225,12 +225,43 @@ def test_export_registry_guards():
         cross_language.clear()
 
 
+def _module_level_export_fn():
+    return 3
+
+
+def test_export_reregistration_is_idempotent():
+    """Module re-import / notebook re-run decorates the SAME
+    module-level function again; each pass builds a fresh wrapper, so
+    identity comparison alone would always collide."""
+    cross_language.clear()
+    try:
+        cross_language.export("re")(_module_level_export_fn)
+        cross_language.export("re")(_module_level_export_fn)
+        assert cross_language.exports() == ["re"]
+
+        # factory closures share a qualname while being different
+        # functions — those keep the strict collision guard
+        def make(k):
+            def handler():
+                return k
+            return handler
+
+        cross_language.export("fac")(make(1))
+        with pytest.raises(ValueError, match="already registered"):
+            cross_language.export("fac")(make(2))
+    finally:
+        cross_language.clear()
+
+
 # ---------------------------------------------------------------------------
 # the real C++ binary
 # ---------------------------------------------------------------------------
 
 def _build_cpp_binary() -> str:
     """g++-compile test_frontend.cc, cached on a source-content hash."""
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain (g++) on this machine")
     srcs = ["test_frontend.cc", "xlang.hpp", "client.hpp"]
     digest = hashlib.sha256()
     for name in srcs:

@@ -296,6 +296,25 @@ class TestHttpIngress:
             serve.run(X.bind(), name="bad", route_prefix="nope")
         assert serve.status("bad") == {"status": "NOT_RUNNING"}
 
+    def test_oversized_body_rejected_before_allocation(self):
+        import socket
+
+        @serve.deployment
+        class Sink:
+            def __call__(self, request):
+                return "ok"
+
+        serve.run(Sink.bind(), route_prefix="/sink")
+        base = serve.http_address()
+        host, port = base.removeprefix("http://").rsplit(":", 1)
+        # an absurd Content-Length with no body: the ingress must 413
+        # WITHOUT trying to allocate/read the claimed bytes
+        with socket.create_connection((host, int(port)), timeout=30) as s:
+            s.sendall(b"POST /sink HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 999999999999\r\n\r\n")
+            reply = s.recv(4096)
+        assert b"413" in reply.split(b"\r\n", 1)[0]
+
     def test_read_only_surfaces_refuse_mutating_verbs(self):
         from ray_tpu.api import _get_runtime
         from ray_tpu.runtime.dashboard import Dashboard
