@@ -115,6 +115,12 @@ class Cluster:
         # node-bandwidth matrix (MB/s) — the pull cost model's input;
         # grows with the CRM row space
         self.bandwidth_mbps = np.zeros((0, 0), dtype=np.int32)
+        # wire-level object plane: this process's endpoint (serves the
+        # head store once a server attaches it) + row -> remote plane
+        # address for agent nodes (None = shares the head store)
+        from .runtime.object_plane import ObjectPlane
+        self.plane = ObjectPlane(self.store)
+        self.planes: dict[int, str | None] = {}
         self.pull_manager = PullManager(self)
         self.recovery = ObjectRecoveryManager(self)
         # owner-side reference counting: ObjectRefs created in this
@@ -145,8 +151,15 @@ class Cluster:
     def _reclaim_object(self, oid) -> None:
         """Refcount hit zero cluster-wide: free the object everywhere and
         release producing-task lineage once all its returns are dead."""
+        rows = self.directory.locations(oid)
         self.store.delete([oid])
         self.directory.drop([oid])
+        # copies on agent planes free over the wire (best-effort, off
+        # the refcount thread)
+        for row in rows:
+            addr = self.planes.get(row)
+            if addr is not None:
+                self.plane.free_on(addr, [oid])
         self.task_manager.on_return_reclaimed(oid)
 
     def _expects_seal(self, oid) -> bool:
@@ -162,15 +175,19 @@ class Cluster:
                  num_workers: int = 2,
                  labels: dict[str, str] | None = None,
                  wait: bool = True, spawner=None,
-                 inline_objects: bool = False) -> NodeID:
+                 inline_objects: bool = False,
+                 plane_address: str | None = None) -> NodeID:
         resources = resources or {"CPU": 2, "memory": 2}
         node_id = NodeID.from_random()
         with self._lock:
             row = self.crm.add_node(node_id,
                                     NodeResources(resources, labels))
             self._grow_bandwidth(row + 1)
+            if plane_address is not None:
+                self.planes[row] = plane_address
             raylet = Raylet(node_id, self, num_workers, spawner=spawner,
-                            inline_objects=inline_objects)
+                            inline_objects=inline_objects,
+                            plane_address=plane_address)
             raylet.actor_manager = self.actor_manager
             self.raylets[row] = raylet
             if self._head_row is None:
@@ -183,6 +200,7 @@ class Cluster:
             # whose raylet never ran
             with self._lock:
                 self.raylets.pop(row, None)
+                self.planes.pop(row, None)
                 self.crm.remove_node(node_id)
                 if self._head_row == row:
                     self._head_row = None
@@ -204,14 +222,20 @@ class Cluster:
 
     def add_remote_node(self, resources: dict[str, float] | None = None,
                         num_workers: int = 2, spawner=None,
-                        labels: dict[str, str] | None = None) -> NodeID:
+                        labels: dict[str, str] | None = None,
+                        plane_address: str | None = None) -> NodeID:
         """A node whose worker processes live behind a node agent on
         another machine (``runtime/node_agent.py``): same raylet, same
-        scheduling row — only the process transport differs, and objects
-        ship in-band (no shared arena across the machine boundary)."""
+        scheduling row — only the process transport differs.  With a
+        ``plane_address`` the agent runs its own arena and objects move
+        arena-to-arena over the object plane (exec/get frames carry
+        by-reference descriptors the agent resolves locally); without
+        one, every payload ships in-band through the head (legacy
+        relay-only agents)."""
         return self.add_node(resources=resources, num_workers=num_workers,
                              labels=labels, spawner=spawner,
-                             inline_objects=True)
+                             inline_objects=True,
+                             plane_address=plane_address)
 
     def _grow_bandwidth(self, n: int) -> None:
         """Extend the bandwidth matrix to cover ``n`` rows (caller holds
@@ -264,6 +288,7 @@ class Cluster:
             if row is None or row == self._head_row:
                 raise ValueError("cannot remove head node or unknown node")
             raylet = self.raylets.pop(row)
+            self.planes.pop(row, None)
             self.crm.remove_node(node_id)
         self.events.emit("node", "node_removed", node_row=row,
                          node_id=node_id.hex())
@@ -273,6 +298,11 @@ class Cluster:
         self.pull_manager.on_objects_lost(lost)
         from .runtime.serialization import RayTaskError
         for oid in lost:
+            # a lost object sealed on an agent plane left a metadata-only
+            # RemoteEntry in the head store: drop it BEFORE re-driving
+            # lineage so readers wait for the fresh seal (or see the
+            # poison below) instead of materializing stale metadata
+            self.store.drop_remote_entry(oid)
             # lineage first: reconstructable objects re-execute their
             # producing task and re-seal; only unrecoverable ones poison
             # (SURVEY §5.3 — reconstruction, else ObjectLostError)
@@ -386,6 +416,7 @@ class Cluster:
         self.ref_counter.shutdown()
         self.pg_manager.shutdown()
         self.pull_manager.shutdown()
+        self.plane.shutdown()
         with self._lock:
             raylets = list(self.raylets.values())
             self.raylets.clear()

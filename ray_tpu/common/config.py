@@ -100,6 +100,14 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 128,
         "Minimum activation batch routed to the device pull-source "
         "kernel; smaller batches use the bit-identical numpy oracle."),
+    "object_transfer_chunk_mb": (
+        int, 4,
+        "Chunk size for wire-level arena-to-arena object transfer "
+        "between node planes (reference ObjectBufferPool chunking)."),
+    "object_transfer_threads": (
+        int, 4,
+        "Concurrent transfer executors in the pull manager; activation "
+        "stays quota-bounded (pull_manager_max_inflight_mb)."),
     "locality_aware_scheduling": (
         bool, True,
         "Prefer placing default-strategy tasks on the node holding the "

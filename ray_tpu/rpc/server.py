@@ -21,7 +21,7 @@ import socket
 import threading
 import traceback
 
-from .wire import recv_frame, send_raw_frame
+from .wire import recv_raw_frame, send_raw_frame
 
 
 class RpcServer:
@@ -30,6 +30,11 @@ class RpcServer:
         """``handlers``: method name -> callable(*args, **kwargs).
         ``port=0`` picks a free port (read it from ``self.address``)."""
         self._handlers = dict(handlers)
+        # per-method wire accounting: method -> [bytes_in, bytes_out].
+        # Tests use this to PROVE data-plane payloads bypass a server
+        # (e.g. object transfers never transiting the head).
+        self.method_bytes: dict = {}
+        self._mb_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -54,14 +59,32 @@ class RpcServer:
 
     # -- codec hooks (pickle protocol; overridden by the xlang gateway) ----
     def _recv_request(self, conn):
-        """One decoded request frame, or None on clean EOF."""
-        return recv_frame(conn)
+        """One request frame (raw bytes here; the decode hook parses),
+        or None on clean EOF."""
+        return recv_raw_frame(conn)
 
     def _decode_request(self, frame):
         """frame -> (req_id, method, args, kwargs), or None to drop the
         connection on a protocol violation."""
-        req_id, method, args, kwargs = frame
+        from ..runtime.serialization import deserialize
+        req_id, method, args, kwargs = deserialize(frame)
         return req_id, method, args, kwargs
+
+    def _account(self, method: str, n_in: int, n_out: int) -> None:
+        with self._mb_lock:
+            row = self.method_bytes.get(method)
+            if row is None:
+                row = self.method_bytes[method] = [0, 0]
+            row[0] += n_in
+            row[1] += n_out
+
+    def total_bytes(self, exclude: tuple = ()) -> int:
+        """Sum of request+reply wire bytes across methods (minus any in
+        ``exclude``)."""
+        with self._mb_lock:
+            return sum(b_in + b_out
+                       for m, (b_in, b_out) in self.method_bytes.items()
+                       if m not in exclude)
 
     def _encode_reply(self, req_id, ok: bool, payload) -> bytes:
         from ..runtime.serialization import serialize
@@ -105,6 +128,8 @@ class RpcServer:
                 if parsed is None:
                     return
                 req_id, method, args, kwargs = parsed
+                if isinstance(frame, (bytes, bytearray)):
+                    self._account(method, len(frame), 0)
                 threading.Thread(
                     target=self._run_handler,
                     args=(conn, wlock, req_id, method, args, kwargs),
@@ -133,6 +158,7 @@ class RpcServer:
             ok = False
             data = self._encode_reply(req_id, False,
                                       self._error_payload(e))
+        self._account(method, 0, len(data))
         try:
             with wlock:
                 send_raw_frame(conn, data)

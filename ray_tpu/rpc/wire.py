@@ -20,6 +20,12 @@ MAX_FRAME = 512 * 1024 * 1024       # sanity bound, not a protocol limit
 
 
 def send_raw_frame(sock: socket.socket, data: bytes) -> None:
+    if len(data) > 1 << 16:
+        # large frame: two sends instead of header+payload concatenation
+        # (the + would copy the whole payload just to prepend 4 bytes)
+        sock.sendall(_LEN.pack(len(data)))
+        sock.sendall(data)
+        return
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -49,13 +55,19 @@ def recv_frame(sock: socket.socket):
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     """``n`` bytes, None on clean EOF; a drop mid-read is an error —
-    silently treating a truncated header as EOF would swallow a frame."""
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            if buf:
+    silently treating a truncated header as EOF would swallow a frame.
+
+    ``recv_into`` a preallocated buffer: ``recv(n)`` with a multi-MB
+    ``n`` makes CPython allocate the full request per call while the
+    kernel delivers ~128KB — O(n^2) allocation across a large frame."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            if got:
                 raise ConnectionError("connection closed mid-frame")
             return None
-        buf.extend(chunk)
+        got += r
     return bytes(buf)

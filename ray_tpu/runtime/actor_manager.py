@@ -216,8 +216,18 @@ class ActorManager:
             rec.worker = worker
             rec.pool = raylet.pool
             rec.row = row
-        payload = serialize((self._materialize_args(rec.init_args),
-                             rec.init_kwargs))
+        try:
+            payload = serialize((self._materialize_args(rec.init_args),
+                                 rec.init_kwargs))
+        except KeyError as e:
+            # an init arg could not materialize at the head (its plane
+            # pull failed / the object was reclaimed): fail the actor's
+            # creation instead of killing this thread and leaving the
+            # record PENDING forever (_on_incarnation_dead reaps the
+            # dedicated worker and refunds its resources)
+            self._on_incarnation_dead(rec.actor_id, init_error=RayTaskError(
+                rec.cls_id, f"actor init argument unavailable: {e}"))
+            return
         worker.send(("fn", rec.cls_id, self._fn_registry[rec.cls_id]))
         worker.send(("actor_new", rec.actor_id.binary(), rec.cls_id,
                      payload))
@@ -230,6 +240,15 @@ class ActorManager:
             return rec.runtime_env if rec is not None else None
 
     def _materialize_args(self, args: tuple) -> tuple:
+        # bytes living only on an agent plane pull to the head first
+        # (actor args materialize head-side)
+        remote = [a.id for a in args if isinstance(a, ObjectRef)
+                  and self._store.plasma_info(a.id)[0] == "remote"]
+        if remote:
+            from .pull_manager import PullPriority
+            self._cluster.pull_manager.pull_blocking(
+                remote, self._cluster.head().row,
+                PullPriority.TASK_ARG, None, self._store)
         out = []
         for a in args:
             if isinstance(a, ObjectRef):
@@ -286,6 +305,13 @@ class ActorManager:
                            if not self._store.contains(d)]
                 if missing:
                     break
+                remote = self._remote_deps(deps)
+                if remote:
+                    # args whose bytes live only on an agent plane pull
+                    # to the head first (actor calls materialize args
+                    # head-side); the pull completion re-pumps
+                    self._pull_remote_deps(remote, actor_id)
+                    return
                 rec.queue.popleft()
                 dep_err = None
                 vals = []
@@ -315,6 +341,23 @@ class ActorManager:
         for d in missing:
             self._store.on_ready(d, lambda _o, a=actor_id: self._pump(a))
 
+    def _remote_deps(self, deps) -> list:
+        """Dep oids whose bytes are NOT materializable at the head: a
+        metadata-only RemoteEntry means the payload lives on an agent
+        plane (shm/spill entries are head-resident by definition — one
+        shared store backs every simulated row)."""
+        return [d for d in deps
+                if self._store.plasma_info(d)[0] == "remote"]
+
+    def _pull_remote_deps(self, oids, actor_id: ActorID) -> None:
+        from .pull_manager import PullPriority
+        head_row = self._cluster.head().row
+        for d in oids:
+            _kind, size = self._store.plasma_info(d)
+            self._cluster.pull_manager.request_pull(
+                d, size, head_row, PullPriority.TASK_ARG,
+                callback=lambda _ok, a=actor_id: self._pump(a))
+
     # -- worker frame handling ---------------------------------------------
     def on_worker_message(self, worker, msg) -> bool:
         """Returns True if the frame was an actor frame and was handled."""
@@ -341,7 +384,7 @@ class ActorManager:
             err = deserialize(msg[2])
             self._on_incarnation_dead(actor_id, init_error=err)
             return True
-        if kind in ("actor_result", "actor_error"):
+        if kind in ("actor_result", "actor_result_x", "actor_error"):
             task_id_bin = msg[1]
             actor_id = getattr(worker, "actor_binding", None)
             with self._lock:
@@ -367,6 +410,23 @@ class ActorManager:
                         self._cluster.seal_serialized(oid, data, row)
                     else:
                         self._store.put_serialized(oid, data)
+            elif kind == "actor_result_x":
+                # plane mode: big results already sealed into the
+                # actor's agent arena — metadata only (location before
+                # seal); in-band bytes seal here, born on the head row.
+                # "p" descriptors are handled UNCONDITIONALLY (d[1] is
+                # an oid binary, never payload bytes) — rec.row can read
+                # -1 when a concurrent kill raced this frame
+                row = rec.row if rec is not None else -1
+                head_row = self._cluster.head().row
+                for i, d in enumerate(msg[2]):
+                    oid = ObjectID.for_task_return(call.task_id, i + 1)
+                    if d[0] == "p":
+                        if row >= 0:
+                            self._cluster.directory.add_location(oid, row)
+                        self._store.put_remote(oid, d[2])
+                    else:
+                        self._cluster.seal_serialized(oid, d[1], head_row)
             else:
                 err = deserialize(msg[2])
                 for i in range(call.num_returns):

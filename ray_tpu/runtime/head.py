@@ -50,11 +50,12 @@ class HeadNode:
         self.jobs.head_address = self.server.address
         if self._rt.cluster.dashboard is not None:
             self._rt.cluster.dashboard.attach_jobs(self.jobs)
-        # worker-node agents join through these handlers
+        # worker-node agents join through these handlers; attach also
+        # serves the head's object plane (agents pull head-resident
+        # objects from it)
         from .node_agent import AgentHub
         self.agent_hub = AgentHub(self._rt.cluster)
-        for name, fn in self.agent_hub.handlers().items():
-            self.server.add_handler(name, fn)
+        self.agent_hub.attach(self.server)
         self._stop_event = threading.Event()
 
     @property

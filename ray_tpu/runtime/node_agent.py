@@ -2,31 +2,40 @@
 
 Reference parity: ``ray start --address=<head>`` boots a worker node
 whose raylet registers with the GCS and leases local worker processes to
-the cluster over gRPC (``NodeManagerService`` — SURVEY.md §1 layers 2-4,
-§3.1; mount empty).  The rebuild keeps ALL scheduling/lease/env state in
-the head process (single source of truth: the head's ``WorkerPool`` and
-``Raylet`` run unchanged) and makes only the process transport remote:
+the cluster over gRPC, with a per-node plasma store and an object
+manager moving payloads between nodes directly (``NodeManagerService`` +
+``src/ray/object_manager/`` — SURVEY.md §1 layers 2-4,6, §3.1, §3.3;
+mount empty).  The rebuild keeps ALL scheduling/lease/env state in the
+head process (the head's ``WorkerPool`` and ``Raylet`` run unchanged)
+and makes the process transport AND the data plane remote:
 
     head                                  agent machine
     ----                                  -------------
     Raylet ── WorkerPool ── AgentSpawner ──TCP── NodeAgent ── pipe ── worker
-                             (spawner seam)        (dumb relay)
+      │ (control frames: by-REFERENCE descriptors)   │ arena+store (plane)
+      └── PullManager ──(op_pull: src → dest direct)─┘
 
-- The **agent** (``NodeAgent``) is a dumb relay daemon: it spawns
-  ``worker_main`` processes locally (same ``LocalSpawner`` mechanics as
-  the head) and shuttles their pipe frames to/from the head over the RPC
-  plane, then registers its node with the head.
+- The **agent** (``NodeAgent``) spawns ``worker_main`` processes locally
+  (same ``LocalSpawner`` mechanics as the head) and shuttles their pipe
+  frames to/from the head over the RPC plane.  It owns a LOCAL object
+  store (arena + spill dir): its workers read plasma args zero-copy from
+  the agent's arena; big task results/puts seal into it and only their
+  METADATA rides to the head (``result_x``/``put_x`` frames).  Payload
+  bytes move between machines over the object plane
+  (``runtime/object_plane.py``) — source to destination directly, never
+  through the head.
 - The **head** (``AgentHub`` + ``AgentSpawner``) serves the agent's
   registration, creates a normal raylet row whose pool spawns through
   the agent, and routes incoming worker frames to virtual pipe
-  connections.  The raylet runs with ``inline_objects=True``: remote
-  workers share no shm arena, so every object payload ships in-band
-  (the reference's cross-node path similarly leaves zero-copy plasma
-  behind at the node boundary).
+  connections.  The raylet runs with a ``plane_address``: exec/get
+  frames carry ``("r", oid)`` descriptors that the agent resolves
+  against its own arena before handing them to the worker.
 
 An agent disconnect (process death, network drop) surfaces through the
 RPC client's ``on_close`` and drives the existing ``remove_node`` drain:
-running tasks retry elsewhere, exactly like a node death.
+running tasks retry elsewhere, objects whose only copy lived on the
+agent recover via lineage or surface ``ObjectLostError`` — exactly like
+a node death.
 
 Limitation (v1, noted): runtime-env ``working_dir``/``py_modules``
 staging paths live on the head's filesystem, so tasks with those envs
@@ -35,13 +44,37 @@ only resolve on agents sharing that filesystem.
 
 from __future__ import annotations
 
+import os
 import queue
+import shutil
+import tempfile
 import threading
+import uuid
+from collections import deque
 
-from ..common.ids import NodeID
+from ..common.ids import NodeID, ObjectID, TaskID
 from .worker_pool import LocalSpawner
 
 _EOF = object()
+
+
+def _make_agent_arena(session_dir: str):
+    """The agent machine's own arena (plasma analogue): /dev/shm when
+    available, session dir otherwise — mirrors the head's
+    ``cluster_utils._make_arena``, including reaping arenas left by
+    crashed sessions (a SIGKILLed agent never runs ``_a_stop``; its
+    /dev/shm file would otherwise leak RAM until reboot)."""
+    from ..cluster_utils import reap_stale_arenas
+    from ..common.config import get_config
+    from ..native import Arena
+    capacity = get_config().object_store_memory_mb * 1024 * 1024
+    name = f"rt_arena_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+    try:
+        reap_stale_arenas("/dev/shm")
+        return Arena(os.path.join("/dev/shm", name), capacity, create=True)
+    except OSError:
+        return Arena(os.path.join(session_dir, name), capacity,
+                     create=True)
 
 
 # ---------------------------------------------------------------------------
@@ -49,7 +82,10 @@ _EOF = object()
 # ---------------------------------------------------------------------------
 
 class NodeAgent:
-    """The daemon on a worker machine: spawn + relay, no state."""
+    """The daemon on a worker machine: spawn + relay + local object
+    plane.  Frame relay stays dumb except where the data plane demands
+    resolution (by-reference descriptors) or extraction (big payloads
+    seal locally; metadata rides up)."""
 
     def __init__(self, head_address: str,
                  resources: dict[str, float] | None = None,
@@ -57,17 +93,36 @@ class NodeAgent:
                  labels: dict[str, str] | None = None,
                  host: str = "127.0.0.1", port: int = 0):
         from ..rpc import RpcClient, RpcServer
+        from .object_plane import ObjectPlane
+        from .object_store import MemoryStore
         self._spawner = LocalSpawner()
         self._workers: dict[int, tuple] = {}    # index -> (proc, conn)
         self._lock = threading.Lock()
         self._stop_event = threading.Event()
-        self.server = RpcServer({
+        # local object plane: own arena + spill dir
+        self._session_dir = tempfile.mkdtemp(prefix="ray_tpu_agent_")
+        self._arena = _make_agent_arena(self._session_dir)
+        self.store = MemoryStore(
+            arena=self._arena,
+            spill_dir=os.path.join(self._session_dir, "spill"))
+        self.plane = ObjectPlane(self.store)
+        # descriptor pins handed to local workers: exec pins release at
+        # the task's result/error frame; get-reply pin batches at the
+        # worker's get_ack (FIFO — the single-threaded worker acks in
+        # receive order), everything at worker EOF
+        self._exec_pins: dict[tuple[int, bytes], list] = {}
+        self._get_pins: dict[int, deque] = {}
+        self._pin_lock = threading.Lock()
+        handlers = {
             "a_spawn": self._a_spawn,
             "a_send": self._a_send,
             "a_kill": self._a_kill,
             "a_stop": self._a_stop,
             "a_ping": lambda: "ok",
-        }, host=host, port=port).start()
+        }
+        handlers.update(self.plane.handlers())
+        self.server = RpcServer(handlers, host=host, port=port).start()
+        self.plane.serve_address = self.server.address
         # head link: frames flow agent->head on this client; its loss
         # (head died) ends the agent — workers without a head are orphans
         self._head = RpcClient(head_address,
@@ -75,7 +130,7 @@ class NodeAgent:
         self.agent_id = NodeID.from_random().hex()
         self.node_id_hex = self._head.call(
             "agent_register", self.agent_id, self.server.address,
-            resources, num_workers, labels)
+            resources, num_workers, labels, True)
 
     def wait_for_shutdown(self, timeout: float | None = None) -> bool:
         return self._stop_event.wait(timeout)
@@ -89,8 +144,10 @@ class NodeAgent:
 
     # -- RPC handlers (called by the head) ----------------------------------
     def _a_spawn(self, index: int, env_payload: dict | None) -> int:
-        """Spawn a local worker; returns its real pid (0 = failed)."""
-        proc, conn = self._spawner.spawn(index, None, env_payload)
+        """Spawn a local worker attached to the AGENT's arena; returns
+        its real pid (0 = failed)."""
+        proc, conn = self._spawner.spawn(index, self._arena.path,
+                                         env_payload)
         with self._lock:
             self._workers[index] = (proc, conn)
         threading.Thread(target=self._pump, args=(index, conn),
@@ -102,10 +159,19 @@ class NodeAgent:
             entry = self._workers.get(index)
         if entry is None:
             return False
+        original = msg
+        try:
+            msg = self._rewrite_down(index, msg)
+            if msg is None:
+                return True     # swallowed: the error frame went up
+        except Exception:   # noqa: BLE001 — unexpected surgery failure:
+            msg = original      # forward as-is; the worker surfaces an
+            #                     unresolved-descriptor error, not a hang
         try:
             entry[1].send(msg)
             return True
         except (OSError, BrokenPipeError):
+            self._release_frame_pins(index, msg)
             return False
 
     def _a_kill(self, index: int) -> None:
@@ -134,8 +200,150 @@ class NodeAgent:
                 conn.close()
             except Exception:   # noqa: BLE001
                 pass
+        self.plane.shutdown()
+        try:
+            self._arena.close()
+        except Exception:       # noqa: BLE001
+            pass
+        shutil.rmtree(self._session_dir, ignore_errors=True)
         self._stop_event.set()
         return "stopping"
+
+    # -- data-plane frame surgery -------------------------------------------
+    def _rewrite_down(self, index: int, msg):
+        """Head->worker: resolve by-reference descriptors against the
+        LOCAL store (pin for the read's duration).  Returns the frame to
+        forward, or None to swallow it (resolution failure already sent
+        an error frame up)."""
+        kind = msg[0]
+        if kind == "exec" and len(msg) == 6 and msg[5]:
+            extern, pins = [], []
+            try:
+                for d in msg[5]:
+                    if d[0] == "r":
+                        desc = self.store.descriptor_of(ObjectID(d[1]))
+                        if desc[0] == "s":
+                            pins.append((ObjectID(d[1]), desc[1]))
+                        extern.append(desc)
+                    else:
+                        extern.append(d)
+            except KeyError:
+                self.store.unpin(pins)
+                self._send_error_up(
+                    index, msg[1],
+                    "task arg is not resident on this node's object "
+                    "plane (transfer failed or the object was freed)")
+                return None
+            if pins:
+                with self._pin_lock:
+                    self._exec_pins[(index, msg[1])] = pins
+            return msg[:5] + (extern,)
+        if kind == "get_reply_x" and msg[1] == "ok":
+            descs, pins = [], []
+            for d in msg[2]:
+                if d[0] == "r":
+                    try:
+                        desc = self.store.descriptor_of(ObjectID(d[1]))
+                    except KeyError:
+                        from .object_store import ObjectLostError
+                        from .serialization import RayTaskError, serialize
+                        desc = ("vb", serialize(RayTaskError(
+                            "get", "object vanished from the local "
+                            "plane", ObjectLostError(d[1].hex()))))
+                    if desc[0] == "s":
+                        pins.append((ObjectID(d[1]), desc[1]))
+                    descs.append(desc)
+                else:
+                    descs.append(d)
+            if pins:
+                with self._pin_lock:
+                    self._get_pins.setdefault(index,
+                                              deque()).append(pins)
+            return (msg[0], msg[1], descs)
+        return msg
+
+    def _rewrite_up(self, index: int, msg):
+        """Worker->head: big payloads seal into the LOCAL store and only
+        metadata rides up; pin releases ride the task lifecycle."""
+        kind = msg[0]
+        if kind in ("result", "actor_result"):
+            self._release_exec_pins(index, msg[1])
+            tid = TaskID(msg[1])
+            descs, any_big = [], False
+            for i, data in enumerate(msg[2]):
+                if len(data) > self.store._threshold:
+                    oid = ObjectID.for_task_return(tid, i + 1)
+                    self.store.put_serialized(oid, data)
+                    k, size = self.store.plasma_info(oid)
+                    if k in ("shm", "spill"):
+                        descs.append(("p", oid.binary(), size))
+                        any_big = True
+                        continue
+                    # store-full in-band fallback: bytes ride up
+                descs.append(("v", data))
+            if any_big:
+                return (kind + "_x", msg[1], descs)
+            return msg
+        if kind in ("error", "actor_error"):
+            self._release_exec_pins(index, msg[1])
+            return msg
+        if kind == "put":
+            if len(msg[2]) > self.store._threshold:
+                oid = ObjectID(msg[1])
+                self.store.put_serialized(oid, msg[2])
+                k, size = self.store.plasma_info(oid)
+                if k in ("shm", "spill"):
+                    return ("put_x", msg[1], size)
+            return msg
+        if kind == "get_ack":
+            with self._pin_lock:
+                dq = self._get_pins.get(index)
+                batch = dq.popleft() if dq else None
+            if batch:
+                self.store.unpin(batch)
+            return msg
+        return msg
+
+    def _send_error_up(self, index: int, task_id_bin: bytes,
+                       message: str) -> None:
+        from .serialization import RayTaskError, serialize
+        try:
+            self._head.call(
+                "agent_frame", self.agent_id, index,
+                ("error", task_id_bin,
+                 serialize(RayTaskError("task", message))))
+        except Exception:       # noqa: BLE001 — head gone
+            pass
+
+    def _release_exec_pins(self, index: int, task_id_bin: bytes) -> None:
+        with self._pin_lock:
+            pins = self._exec_pins.pop((index, task_id_bin), None)
+        if pins:
+            self.store.unpin(pins)
+
+    def _release_frame_pins(self, index: int, msg) -> None:
+        """A rewritten frame failed to send: release the pins it carried
+        (its ack/result will never come)."""
+        kind = msg[0]
+        if kind == "exec":
+            self._release_exec_pins(index, msg[1])
+        elif kind == "get_reply_x":
+            with self._pin_lock:
+                dq = self._get_pins.get(index)
+                batch = dq.pop() if dq else None
+            if batch:
+                self.store.unpin(batch)
+
+    def _release_index_pins(self, index: int) -> None:
+        """Worker died/exited: every descriptor it held is dead."""
+        with self._pin_lock:
+            pins = []
+            for key in [k for k in self._exec_pins if k[0] == index]:
+                pins.extend(self._exec_pins.pop(key))
+            for batch in self._get_pins.pop(index, ()):
+                pins.extend(batch)
+        if pins:
+            self.store.unpin(pins)
 
     # -- worker->head pump ---------------------------------------------------
     def _pump(self, index: int, conn) -> None:
@@ -145,10 +353,15 @@ class NodeAgent:
             except (EOFError, OSError):
                 break
             try:
+                msg = self._rewrite_up(index, msg)
+            except Exception:   # noqa: BLE001 — surgery must not drop
+                pass            # the frame; forward as-is
+            try:
                 self._head.call("agent_frame", self.agent_id, index, msg)
             except Exception:   # noqa: BLE001 — head gone: nothing to
                 return          # relay to; the on_close hook is already
                 #                 ending the agent
+        self._release_index_pins(index)
         try:
             self._head.call("agent_eof", self.agent_id, index)
         except Exception:       # noqa: BLE001
@@ -313,8 +526,9 @@ class AgentSpawner:
 class AgentHub:
     """Head-side registry: serves agent registration + frame routing.
 
-    Attach its handlers to the head's RpcServer (``HeadNode`` does this;
-    tests may attach to any server fronting a cluster)."""
+    Attach via ``attach(server)`` (``HeadNode`` does this; tests may
+    attach to any server fronting a cluster) — it also exposes the
+    head's object plane so agents can pull head-resident objects."""
 
     def __init__(self, cluster):
         self._cluster = cluster
@@ -329,9 +543,14 @@ class AgentHub:
             "agent_bye": self.bye,
         }
 
+    def attach(self, server) -> None:
+        for name, fn in self.handlers().items():
+            server.add_handler(name, fn)
+        self._cluster.plane.attach(server)
+
     def register(self, agent_id: str, agent_address: str,
                  resources: dict | None, num_workers: int,
-                 labels: dict | None) -> str:
+                 labels: dict | None, plane: bool = False) -> str:
         # the disconnect hook is live from the START — an agent dying
         # mid-registration must still tear down whatever exists by then
         spawner = AgentSpawner(
@@ -345,7 +564,8 @@ class AgentHub:
         try:
             node_id = self._cluster.add_remote_node(
                 resources=resources, num_workers=num_workers,
-                spawner=spawner, labels=labels)
+                spawner=spawner, labels=labels,
+                plane_address=agent_address if plane else None)
         except BaseException:
             with self._lock:
                 self._agents.pop(agent_id, None)

@@ -48,6 +48,13 @@ class ObjectStoreFullError(RayError, MemoryError):
     ``ray.exceptions.ObjectStoreFullError``)."""
 
 
+class RemoteObjectUnavailable(KeyError):
+    """A read hit a metadata-only RemoteEntry: the bytes live on another
+    node's plane and were not pulled first.  Read paths are expected to
+    go through the pull manager; this surfacing means a caller skipped
+    it."""
+
+
 @dataclass
 class ShmEntry:
     """Sealed serialized payload resident in the shared arena.
@@ -65,6 +72,23 @@ class SpillEntry:
     """Payload spilled to disk; restored to the arena on access."""
     path: str
     size: int
+
+
+@dataclass
+class RemoteEntry:
+    """Metadata-only seal: the payload lives in ANOTHER node's store
+    (rows per the object directory).  The head records these for objects
+    sealed on agent machines so dependency tracking (`contains`,
+    `on_ready`) works without the bytes ever transiting the head —
+    materialization goes through the pull manager, which replaces this
+    entry with real bytes via ``begin_ingest``/``commit`` (reference:
+    the local plasma store simply lacks the object and the PullManager
+    fetches it; here absence-with-metadata is an explicit entry because
+    one store doubles as the owner's metadata table)."""
+    size: int
+
+# plasma_info() kinds that are directory-tracked and transferable
+PLASMA_KINDS = ("shm", "spill", "remote")
 
 
 class MemoryStore:
@@ -94,9 +118,12 @@ class MemoryStore:
 
     # -- write --------------------------------------------------------------
     def put(self, object_id: ObjectID, value) -> None:
-        """Seal an in-band Python value (first write wins)."""
+        """Seal an in-band Python value (first write wins; real bytes
+        upgrade a metadata-only RemoteEntry)."""
         with self._cv:
-            if object_id in self._objects:
+            existing = self._objects.get(object_id)
+            if existing is not None and \
+                    not isinstance(existing, RemoteEntry):
                 return
             self._objects[object_id] = value
             listeners = self._listeners.pop(object_id, ())
@@ -113,7 +140,9 @@ class MemoryStore:
             self.put(object_id, deserialize(data))
             return
         with self._cv:
-            if object_id in self._objects:
+            existing = self._objects.get(object_id)
+            if existing is not None and \
+                    not isinstance(existing, RemoteEntry):
                 return
             try:
                 entry = self._shm_put_locked(data)
@@ -215,6 +244,133 @@ class MemoryStore:
         self._objects[object_id] = shm
         return shm
 
+    def put_remote(self, object_id: ObjectID, size: int) -> None:
+        """Seal a remote-resident object's METADATA (first write wins):
+        the bytes live on another node's plane; local readers go through
+        the pull manager, which ingests real bytes over this entry."""
+        with self._cv:
+            if object_id in self._objects:
+                return
+            self._objects[object_id] = RemoteEntry(size)
+            listeners = self._listeners.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
+    # -- wire-level transfer (object plane) ----------------------------------
+    def read_range(self, object_id: ObjectID, offset: int,
+                   length: int) -> bytes | None:
+        """One chunk of a sealed payload for an arena-to-arena transfer;
+        None when the object has no local bytes (absent/remote/in-band).
+        Shm reads copy under a transient pin so a concurrent spill/free
+        cannot reallocate the block mid-read; spill reads go straight to
+        the file without restoring.  A spill file vanishing mid-read is
+        re-checked against the entry — a concurrent RESTORE unlinks the
+        file while moving the bytes into the arena (the object is still
+        live; only a true delete returns None)."""
+        for _ in range(4):
+            with self._cv:
+                entry = self._objects.get(object_id)
+                if isinstance(entry, ShmEntry):
+                    entry.pins += 1
+                    pin = (object_id, entry.offset)
+                    view = self.arena.view(entry.offset + offset,
+                                           min(length,
+                                               entry.size - offset))
+                elif isinstance(entry, SpillEntry):
+                    path = entry.path
+                    view = None
+                else:
+                    return None
+            if view is not None:
+                try:
+                    return bytes(view)
+                finally:
+                    self.unpin([pin])
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                continue        # restore/delete raced: re-check entry
+        return None
+
+    def begin_ingest(self, object_id: ObjectID, size: int):
+        """Start receiving a remote object's bytes: returns an
+        ``IngestHandle`` (write chunks, then commit — which seals over
+        any RemoteEntry), or None when local bytes already exist.
+        Prefers an arena block (spilling LRU victims for room); falls
+        back to writing a spill file when the arena cannot take it."""
+        with self._cv:
+            entry = self._objects.get(object_id)
+            if entry is not None and not isinstance(entry, RemoteEntry):
+                return None
+            if self.arena is not None and size > self._threshold:
+                try:
+                    shm = self._alloc_ingest_locked(size)
+                    return _IngestHandle(self, object_id, shm=shm)
+                except ObjectStoreFullError:
+                    pass
+        if self._spill_dir is None:
+            # tiny object or no spill dir: buffer in memory
+            return _IngestHandle(self, object_id, buf=bytearray(size))
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir,
+                            object_id.hex() + ".ingest")
+        return _IngestHandle(self, object_id, path=path, size=size)
+
+    def _alloc_ingest_locked(self, size: int) -> ShmEntry:
+        """Arena block for an in-flight ingest (caller holds the lock).
+        Pinned from birth so the spill scan never victimizes a block
+        that is still being written."""
+        shm = self._shm_put_locked_alloc(size)
+        shm.pins = 1
+        return shm
+
+    def _shm_put_locked_alloc(self, size: int) -> ShmEntry:
+        """Allocate (no copy) with the same eviction discipline as
+        ``_shm_put_locked``."""
+        from ..native import ArenaFullError
+        if size >= self.arena.capacity():
+            raise ObjectStoreFullError(
+                f"payload of {size} bytes exceeds arena capacity "
+                f"{self.arena.capacity()}")
+        self._maybe_spill_locked(size)
+        while True:
+            try:
+                off = self.arena.alloc(size)
+                return ShmEntry(off, size)
+            except ArenaFullError:
+                if not self._spill_one_locked():
+                    raise ObjectStoreFullError(
+                        f"object store full: cannot place {size} bytes "
+                        f"(capacity {self.arena.capacity()})") from None
+
+    def _commit_ingest(self, object_id: ObjectID, entry) -> None:
+        """Seal ingested bytes over an absent or RemoteEntry slot."""
+        with self._cv:
+            existing = self._objects.get(object_id)
+            if existing is not None and \
+                    not isinstance(existing, RemoteEntry):
+                # lost the race to another ingest/seal: discard ours
+                self._release_entry(entry)
+                return
+            if isinstance(entry, ShmEntry):
+                entry.pins = 0      # birth pin released at seal
+            self._objects[object_id] = entry
+            listeners = self._listeners.pop(object_id, ())
+            self._cv.notify_all()
+        for cb in listeners:
+            cb(object_id)
+
+    def drop_remote_entry(self, object_id: ObjectID) -> None:
+        """Remove a metadata-only RemoteEntry (its backing copies are
+        gone — node death).  Real local entries are left alone; waiters
+        re-park on absence and wake at the re-seal or poison."""
+        with self._cv:
+            if isinstance(self._objects.get(object_id), RemoteEntry):
+                del self._objects[object_id]
+
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
         with self._cv:
             for oid in object_ids:
@@ -293,6 +449,8 @@ class MemoryStore:
                 return "shm", e.size
             if isinstance(e, SpillEntry):
                 return "spill", e.size
+            if isinstance(e, RemoteEntry):
+                return "remote", e.size
             return (None, 0) if e is None else ("inband", 0)
 
     def poison(self, object_id: ObjectID, error) -> None:
@@ -318,6 +476,10 @@ class MemoryStore:
         """Deserialize/restore an entry into a Python value; touches LRU."""
         entry = self._objects[object_id]
         self._objects.move_to_end(object_id)
+        if isinstance(entry, RemoteEntry):
+            raise RemoteObjectUnavailable(
+                f"object {object_id.hex()[:12]} is resident on a remote "
+                "plane; pull it first")
         if isinstance(entry, SpillEntry):
             entry = self._restore_locked(object_id, entry)
             if isinstance(entry, bytes):
@@ -334,6 +496,10 @@ class MemoryStore:
         ``unpin([object_id])`` once the worker is done with the block."""
         entry = self._objects[object_id]
         self._objects.move_to_end(object_id)
+        if isinstance(entry, RemoteEntry):
+            raise RemoteObjectUnavailable(
+                f"object {object_id.hex()[:12]} is resident on a remote "
+                "plane; pull it first")
         if isinstance(entry, SpillEntry):
             entry = self._restore_locked(object_id, entry)
             if isinstance(entry, bytes):
@@ -401,6 +567,15 @@ class MemoryStore:
             ready_list = [o for o in object_ids if o in ready_set]
             not_ready = [o for o in object_ids if o not in ready_set]
             return ready_list, not_ready
+
+    def get_raw_presence(self, object_ids: Sequence[ObjectID],
+                         timeout: float | None = None) -> bool:
+        """Block until every id EXISTS (any entry kind, including
+        metadata-only RemoteEntry); no materialization.  False on
+        timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            return self._await_locked(object_ids, deadline)
 
     def get_raw_blocking(self, object_ids: Sequence[ObjectID],
                          timeout: float | None = None) -> list | None:
@@ -475,6 +650,8 @@ class MemoryStore:
                     out.append((oid, entry.size, "shm"))
                 elif isinstance(entry, SpillEntry):
                     out.append((oid, entry.size, "spilled"))
+                elif isinstance(entry, RemoteEntry):
+                    out.append((oid, entry.size, "remote"))
                 else:
                     out.append((oid, -1, "in_band"))
             return out
@@ -485,10 +662,13 @@ class MemoryStore:
                       for e in self._objects.values())
             spilled = sum(isinstance(e, SpillEntry)
                           for e in self._objects.values())
+            remote = sum(isinstance(e, RemoteEntry)
+                         for e in self._objects.values())
             return {
                 "num_objects": len(self._objects),
                 "num_shm": shm,
                 "num_spilled": spilled,
+                "num_remote": remote,
                 "num_pinned": sum(
                     isinstance(e, ShmEntry) and e.pins > 0
                     for e in self._objects.values()),
@@ -499,3 +679,65 @@ class MemoryStore:
                 "spilled_bytes": self.spilled_bytes,
                 "restored_bytes": self.restored_bytes,
             }
+
+
+class _IngestHandle:
+    """Destination side of one arena-to-arena transfer: chunks land
+    directly in their final home (arena block, spill file, or an
+    in-memory buffer for sub-threshold payloads) — no whole-object
+    staging copy.  ``commit`` seals; ``abort`` releases."""
+
+    def __init__(self, store: MemoryStore, object_id: ObjectID,
+                 shm: ShmEntry | None = None, path: str | None = None,
+                 size: int = 0, buf: bytearray | None = None):
+        self._store = store
+        self._oid = object_id
+        self._shm = shm
+        self._path = path
+        self._size = size if shm is None else shm.size
+        self._buf = buf
+        self._file = open(path, "wb") if path is not None else None
+        self._done = False
+
+    def write(self, offset: int, data: bytes) -> None:
+        if self._shm is not None:
+            self._store.arena.write(self._shm.offset + offset,
+                                    memoryview(data))
+        elif self._buf is not None:
+            self._buf[offset:offset + len(data)] = data
+        else:
+            self._file.seek(offset)
+            self._file.write(data)
+
+    def commit(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._shm is not None:
+            self._store._commit_ingest(self._oid, self._shm)
+        elif self._buf is not None:
+            # sub-threshold payload: seal as the in-band value, like
+            # put_serialized's small route
+            self._store._commit_ingest(self._oid,
+                                       deserialize(bytes(self._buf)))
+        else:
+            self._file.close()
+            final = self._path[:-len(".ingest")]
+            os.replace(self._path, final)
+            self._store.spilled_bytes += self._size
+            self._store._commit_ingest(self._oid,
+                                       SpillEntry(final, self._size))
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._shm is not None:
+            with self._store._cv:
+                self._store.arena.free(self._shm.offset)
+        elif self._file is not None:
+            self._file.close()
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass

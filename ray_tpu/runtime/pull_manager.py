@@ -47,6 +47,7 @@ class PullManager:
         self._quota = cfg.pull_manager_max_inflight_mb * (1 << 20)
         self._sim_gbps = cfg.pull_transfer_sim_gbps
         self._device_min = cfg.pull_device_batch_min
+        self._n_threads = max(1, cfg.object_transfer_threads)
         self._cv = threading.Condition()
         # pending requests: key (oid, dest_row) -> state dict
         self._requests: dict[tuple, dict] = {}
@@ -55,7 +56,7 @@ class PullManager:
         self._active: deque = deque()       # (key, src_row) awaiting transfer
         self._inflight_bytes = 0
         self._stop = False
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
         # stats
         self.num_pulls = 0
         self.bytes_pulled = 0
@@ -123,8 +124,9 @@ class PullManager:
                     done.set()
 
         def on_present(oid):
+            from .object_store import PLASMA_KINDS
             kind, size = store.plasma_info(oid)
-            if kind in ("shm", "spill"):
+            if kind in PLASMA_KINDS:
                 self.request_pull(oid, size, dest_row, priority,
                                   callback=one_done)
             else:
@@ -142,10 +144,13 @@ class PullManager:
 
     # -- activation (quota + source selection) -------------------------------
     def _ensure_thread_locked(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(target=self._transfer_loop,
-                                            daemon=True, name="pull-manager")
-            self._thread.start()
+        self._threads = [t for t in self._threads if t.is_alive()]
+        while len(self._threads) < self._n_threads:
+            t = threading.Thread(
+                target=self._transfer_loop, daemon=True,
+                name=f"pull-manager-{len(self._threads)}")
+            t.start()
+            self._threads.append(t)
 
     def _activate_locked(self) -> None:
         """Move queued requests into the active transfer set while the
@@ -225,13 +230,42 @@ class PullManager:
                 req = self._requests.pop(key, None)
             if req is None:
                 continue
-            if self._sim_gbps > 0:
-                time.sleep(req["size"] / (self._sim_gbps * 1e9))
             oid, dest = key
             # the object may have been lost mid-transfer (source node
             # died): a lost object is untracked — do not resurrect it
             ok = self._cluster.directory.is_tracked(oid)
             if ok:
+                ok = self._move_bytes(oid, dest, src, req["size"])
+                if not ok and self._cluster.directory.is_tracked(oid) \
+                        and req.get("attempts", 0) < 2:
+                    # transient transfer failure (chunk RPC timeout,
+                    # spill race) on a LIVE object: re-queue for another
+                    # source-selection round instead of surfacing a
+                    # bogus permanent loss to the waiters
+                    req["attempts"] = req.get("attempts", 0) + 1
+                    time.sleep(0.2 * req["attempts"])
+                    with self._cv:
+                        self._inflight_bytes -= req["size"]
+                        dup = self._requests.get(key)
+                        if dup is not None:
+                            # a fresh request for the same key arrived
+                            # mid-transfer: merge instead of clobbering
+                            dup["callbacks"].extend(req["callbacks"])
+                            dup["priority"] = min(dup["priority"],
+                                                  req["priority"])
+                        else:
+                            req["active"] = False
+                            self._requests[key] = req
+                            self._seq += 1
+                            heapq.heappush(
+                                self._heap,
+                                (int(req["priority"]), self._seq, key))
+                        self._activate_locked()
+                    continue
+            if ok:
+                # bytes land BEFORE the directory update: a callback
+                # (task dispatch, get) must never observe a location
+                # whose plane cannot serve the object yet
                 self._cluster.directory.add_location(oid, dest)
             with self._cv:
                 self._inflight_bytes -= req["size"]
@@ -243,6 +277,30 @@ class PullManager:
                 self._activate_locked()
             for cb in req["callbacks"]:
                 cb(ok)
+
+    def _move_bytes(self, oid, dest: int, src: int, size: int) -> bool:
+        """Execute one transfer.  Simulated rows share the head arena
+        (the transfer is a directory update, optionally paced); rows
+        with a plane address move real chunks arena-to-arena — payload
+        bytes flow source→destination directly, never through here."""
+        planes = self._cluster.planes
+        src_addr = planes.get(src)
+        dest_addr = planes.get(dest)
+        if src_addr is None and dest_addr is None:
+            if self._sim_gbps > 0:
+                time.sleep(size / (self._sim_gbps * 1e9))
+            return True
+        plane = self._cluster.plane
+        if dest_addr is None:
+            # destination shares the head store: fetch here
+            return plane.pull_into_local(oid, size, src_addr)
+        # destination is an agent plane: it pulls from the source plane
+        # (the head's own serving address when the source is head-local)
+        if src_addr is None:
+            src_addr = plane.serve_address
+            if src_addr is None:
+                return False    # head store is not being served
+        return plane.request_remote_pull(dest_addr, oid, size, src_addr)
 
     # -- loss / teardown -----------------------------------------------------
     def on_objects_lost(self, object_ids) -> None:

@@ -96,12 +96,19 @@ class _ClassQueue:
 
 class Raylet:
     def __init__(self, node_id, cluster, num_workers: int,
-                 spawner=None, inline_objects: bool = False):
+                 spawner=None, inline_objects: bool = False,
+                 plane_address: str | None = None):
         self.node_id = node_id
         self.cluster = cluster
         # remote-node raylet: workers live on another machine (node
-        # agent) and share no arena — every object payload ships in-band
+        # agent) and share no arena with the head.  With a plane address
+        # the agent runs its own arena: plasma args/results move over
+        # the object plane and frames carry by-REFERENCE descriptors the
+        # agent resolves against its local store; without one (legacy),
+        # every payload ships in-band through the head
         self.inline_objects = inline_objects
+        self.plane_address = plane_address
+        self.remote_plane = plane_address is not None
         self.crm = cluster.crm
         self.row = self.crm.row_of(node_id)
         self.store = cluster.store
@@ -249,8 +256,9 @@ class Raylet:
         if rec is not None:
             for a in rec.spec.args:
                 if isinstance(a, ObjectRef):
+                    from .object_store import PLASMA_KINDS
                     kind, size = self.store.plasma_info(a.id)
-                    if kind in ("shm", "spill") and \
+                    if kind in PLASMA_KINDS and \
                             not self.cluster.directory.has_location(
                                 a.id, self.row):
                         pulls.append((a.id, size))
@@ -581,10 +589,11 @@ class Raylet:
         if not spec.args or not get_config().locality_aware_scheduling:
             return None
         by_row: dict[int, int] = {}
+        from .object_store import PLASMA_KINDS
         for a in spec.args:
             if isinstance(a, ObjectRef):
                 kind, size = self.store.plasma_info(a.id)
-                if kind in ("shm", "spill"):
+                if kind in PLASMA_KINDS:
                     for r in self.cluster.directory.locations(a.id):
                         by_row[r] = by_row.get(r, 0) + size
         if not by_row:
@@ -847,14 +856,27 @@ class Raylet:
         spec = rec.spec
         # resolve top-level ObjectRef args (deps are ready by construction)
         # as store descriptors: shm-resident args reach the worker as
-        # (offset, size) and are read zero-copy; errors are always in-band
+        # (offset, size) and are read zero-copy; errors are always in-band.
+        # Plane-backed remote nodes ship plasma args BY REFERENCE in the
+        # frame's extern table — the agent resolves them against its own
+        # arena, so payload bytes never transit the head (reference: task
+        # args resolve in the executing node's local plasma store)
+        from .object_store import PLASMA_KINDS
         from .worker import ArgRef
         args = []
+        extern: list = []       # frame-level descriptors (outside the
+        #                         payload pickle, rewritable by the agent)
         pinned: list = []       # shm args stay pinned until task completion
         dep_error = None
         vanished = None
         for a in spec.args:
             if isinstance(a, ObjectRef):
+                if self.remote_plane:
+                    kind, _size = self.store.plasma_info(a.id)
+                    if kind in PLASMA_KINDS:
+                        extern.append(("r", a.id.binary()))
+                        args.append(ArgRef(("x", len(extern) - 1)))
+                        continue
                 try:
                     desc = self.store.descriptor_of(a.id)
                 except KeyError:
@@ -864,8 +886,8 @@ class Raylet:
                     break
                 if desc[0] == "s":
                     if self.inline_objects:
-                        # remote worker: copy out of the arena under the
-                        # pin, ship bytes, release immediately
+                        # remote worker with no plane: copy out of the
+                        # arena under the pin, ship bytes, release now
                         desc = ("b", self.store.inline_bytes(a.id, desc))
                     else:
                         pinned.append((a.id, desc[1]))
@@ -915,7 +937,7 @@ class Raylet:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
                                                     pinned)
         if not worker.send(("exec", spec.task_id.binary(), fn_id, payload,
-                            spec.trace_ctx)):
+                            spec.trace_ctx, extern)):
             with self._cv:
                 entry = self._running.pop(spec.task_id.binary(), None)
             if entry is not None:
@@ -1222,7 +1244,7 @@ class Raylet:
                 worker.send(("named_actor_reply",
                              aid.binary() if aid else None))
                 return
-        if kind in ("result", "error"):
+        if kind in ("result", "result_x", "error"):
             task_id_bin = msg[1]
             with self._cv:
                 entry = self._running.pop(task_id_bin, None)
@@ -1251,6 +1273,8 @@ class Raylet:
                 # concludes the object will never seal and leaks it
                 if kind == "result":
                     self._seal_results(rec, msg[2])
+                elif kind == "result_x":
+                    self._seal_results_x(rec, msg[2])
                 else:
                     err = deserialize(msg[2])
                     for oid in rec.return_ids:
@@ -1270,6 +1294,10 @@ class Raylet:
             # zero-copy read on the worker's own arena mapping
             if all(self.store.contains(o) for o in oids) and \
                     all(self._object_local(o) for o in oids):
+                if self.remote_plane:
+                    worker.send(("get_reply_x", "ok",
+                                 self._remote_get_descs(oids)))
+                    return
                 descs = self.store.get_descriptors_blocking(oids)
                 self._send_get_reply(worker, oids, descs)
                 return
@@ -1284,6 +1312,16 @@ class Raylet:
             self._enter_blocked(worker, rec)
             pulled = self.cluster.pull_manager.pull_blocking(
                 oids, self.row, PullPriority.GET, timeout, self.store)
+            if self.remote_plane:
+                ok = pulled and self.store.get_raw_presence(
+                    oids, timeout=timeout)
+                self._exit_blocked(worker, rec)
+                if not ok:
+                    worker.send(("get_reply_x", "timeout", None))
+                else:
+                    worker.send(("get_reply_x", "ok",
+                                 self._remote_get_descs(oids)))
+                return
             descs = self.store.get_descriptors_blocking(
                 oids, timeout=timeout) if pulled else None
             self._exit_blocked(worker, rec)
@@ -1315,10 +1353,11 @@ class Raylet:
             # warm locality for satisfied waits (reference: wait triggers
             # pulls below get priority); readiness itself is presence-based
             from .pull_manager import PullPriority
+            from .object_store import PLASMA_KINDS
             for o in ready:
                 if not self._object_local(o):
                     kind, size = self.store.plasma_info(o)
-                    if kind in ("shm", "spill"):
+                    if kind in PLASMA_KINDS:
                         self.cluster.pull_manager.request_pull(
                             o, size, self.row, PullPriority.WAIT)
             worker.send(("wait_reply",
@@ -1326,6 +1365,12 @@ class Raylet:
         elif kind == "put":
             oid = self._oid(msg[1])
             self.cluster.seal_serialized(oid, msg[2], self.row)
+        elif kind == "put_x":
+            # a plane agent already sealed the put payload into its own
+            # arena: record metadata only (location before seal)
+            oid = self._oid(msg[1])
+            self.cluster.directory.add_location(oid, self.row)
+            self.store.put_remote(oid, msg[2])
         elif kind == "submit":
             spec = deserialize(msg[1])
             fn_id, fn_bytes = msg[2], msg[3]
@@ -1378,6 +1423,55 @@ class Raylet:
                 # would live forever (no refs remain to ever decref it)
             self.cluster.seal_serialized(oid, data, self.row)
 
+    def _seal_results_x(self, rec, descs) -> None:
+        """Seal plane-mode return descriptors: ("p", oid_bin, size) means
+        the agent already sealed the payload into ITS arena — the head
+        records metadata only (directory location BEFORE the remote seal,
+        the seal_serialized ordering); ("v", bytes) rode in-band and
+        seals here, born on the HEAD row (that is where the bytes are)."""
+        head_row = self.cluster.head().row
+        for oid, d in zip(rec.return_ids, descs):
+            if oid in rec.dead_returns:
+                if d[0] == "p" and self.plane_address is not None:
+                    # nobody will ever reference it: free the agent copy
+                    self.cluster.plane.free_on(self.plane_address, [oid])
+                continue
+            if d[0] == "p":
+                self.cluster.directory.add_location(oid, self.row)
+                self.store.put_remote(oid, d[2])
+            else:
+                self.cluster.seal_serialized(oid, d[1], head_row)
+
+    def _remote_get_descs(self, oids) -> list:
+        """Get-reply descriptors for a plane-backed remote worker: plasma
+        objects with a copy on this row ship by reference ("r" — the
+        agent resolves them against its own arena, bytes never transit
+        the head); head-resident bytes inline under the pin; in-band
+        values ship serialized (the relay never unpickles user data)."""
+        from .object_store import PLASMA_KINDS
+        out = []
+        for o in oids:
+            kind, _size = self.store.plasma_info(o)
+            if kind in PLASMA_KINDS and \
+                    self.cluster.directory.has_location(o, self.row):
+                out.append(("r", o.binary()))
+                continue
+            try:
+                desc = self.store.descriptor_of(o)
+            except KeyError:
+                # vanished post-wait (reclaim race): surface as an error
+                from .object_store import ObjectLostError
+                desc = ("v", RayTaskError(
+                    "get", f"object {o.hex()[:12]} was reclaimed",
+                    ObjectLostError(o.hex())))
+            if desc[0] == "s":
+                out.append(("b", self.store.inline_bytes(o, desc)))
+            elif desc[0] == "v":
+                out.append(("vb", serialize(desc[1])))
+            else:
+                out.append(desc)
+        return out
+
     def _send_get_reply(self, worker: WorkerHandle, oids, descs) -> None:
         """Ship get descriptors; shm descriptors were pinned by the store,
         so record them for release on the worker's get_ack (every reply
@@ -1414,8 +1508,9 @@ class Raylet:
     def _object_local(self, oid) -> bool:
         """True when a get/dispatch on this node needs no pull: in-band
         value, or a plasma object with a local copy."""
+        from .object_store import PLASMA_KINDS
         kind, _ = self.store.plasma_info(oid)
-        return kind not in ("shm", "spill") or \
+        return kind not in PLASMA_KINDS or \
             self.cluster.directory.has_location(oid, self.row)
 
     def _drain_worker_pins(self, worker: WorkerHandle) -> None:

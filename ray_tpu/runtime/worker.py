@@ -66,24 +66,36 @@ class WorkerApiContext:
         from collections import deque
         self.pending_frames = deque()
 
-    def _materialize(self, desc):
-        """Resolve a get-reply descriptor: in-band value, in-band bytes,
-        or a zero-copy read of the shared arena."""
+    def _materialize(self, desc, extern=None):
+        """Resolve a descriptor: in-band value ("v"), in-band serialized
+        value ("vb"), in-band serialized payload ("b"), a zero-copy
+        arena read ("s"), or an extern-table indirection ("x" — plane
+        mode ships plasma descriptors OUTSIDE the payload pickle so the
+        node agent can resolve them against its local arena)."""
         kind = desc[0]
+        if kind == "x":
+            desc = extern[desc[1]]
+            kind = desc[0]
         if kind == "v":
             return desc[1]
-        if kind == "b":
+        if kind in ("b", "vb"):
             return deserialize(desc[1])
+        if kind == "r":
+            raise RuntimeError(
+                "unresolved by-reference descriptor reached the worker "
+                "(the node agent failed to rewrite it)")
         # ("s", offset, size): attach the arena once, read zero-copy
         if self._arena is None:
             from ..native import Arena
             self._arena = Arena(self._arena_path)
         return deserialize(self._arena.view(desc[1], desc[2]))
 
-    def _recv_reply(self, expected_kind: str):
+    def _recv_reply(self, expected_kinds):
+        if isinstance(expected_kinds, str):
+            expected_kinds = (expected_kinds,)
         while True:
             msg = self._conn.recv()
-            if msg[0] == expected_kind:
+            if msg[0] in expected_kinds:
                 return msg
             self.pending_frames.append(msg)
 
@@ -102,8 +114,11 @@ class WorkerApiContext:
     # -- API ----------------------------------------------------------------
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
         self._conn.send(("get", [r.binary() for r in refs], timeout))
-        _, payload = self._recv_reply("get_reply")
-        status, descs = deserialize(payload)
+        msg = self._recv_reply(("get_reply", "get_reply_x"))
+        if msg[0] == "get_reply":
+            status, descs = deserialize(msg[1])
+        else:       # plane mode: descriptors ride outside the pickle
+            status, descs = msg[1], msg[2]
         if status == "timeout":
             from .object_store import GetTimeoutError
             raise GetTimeoutError(
@@ -111,8 +126,8 @@ class WorkerApiContext:
         try:
             values = [self._materialize(d) for d in descs]
         finally:
-            # ack releases the raylet-side pins on this reply's shm
-            # descriptors; sent only when the reply carried any
+            # ack releases the raylet/agent-side pins on this reply's
+            # shm descriptors; sent only when the reply carried any
             if any(d[0] == "s" for d in descs):
                 self._conn.send(("get_ack",))
         for v in values:
@@ -220,10 +235,14 @@ def worker_main(conn, worker_index: int,
         if kind == "fn":
             fn_table[msg[1]] = deserialize(msg[2])
         elif kind == "exec":
-            _, task_id_bin, fn_id, payload, trace_ctx = msg
+            if len(msg) == 6:
+                _, task_id_bin, fn_id, payload, trace_ctx, extern = msg
+            else:           # pre-plane frame shape
+                _, task_id_bin, fn_id, payload, trace_ctx = msg
+                extern = None
             args, kwargs, num_returns = deserialize(payload)
-            args = tuple(ctx._materialize(a.desc) if isinstance(a, ArgRef)
-                         else a for a in args)
+            args = tuple(ctx._materialize(a.desc, extern)
+                         if isinstance(a, ArgRef) else a for a in args)
             fn = fn_table[fn_id]
             name = getattr(fn, "__qualname__", str(fn))
             ctx.begin_task(TaskID(task_id_bin))
