@@ -103,8 +103,16 @@ class DriverRuntime:
         # size-routed like the reference: large serialized payloads seal
         # into the shared arena (location pre-registered — see
         # Cluster.seal_serialized); small values stay in-band
-        data = serialize(value)
+        from .common.ids import ObjectID as _OID
+        from .runtime.object_ref import serialize_collecting
+        data, contained = serialize_collecting(value)
         if self.store.routes_to_plasma(len(data)):
+            if contained:
+                # arena payloads hold no Python refs: register the refs
+                # pickled inside so their objects outlive the holder's
+                # own copies while this blob is alive
+                self.cluster.ref_counter.add_contained(
+                    oid, [_OID(b) for b in contained])
             self.cluster.seal_serialized(oid, data, self.raylet.row)
         else:
             self.store.put(oid, value)

@@ -35,6 +35,11 @@ class RpcServer:
         # (e.g. object transfers never transiting the head).
         self.method_bytes: dict = {}
         self._mb_lock = threading.Lock()
+        # per-connection cleanup callbacks (registered by handlers via
+        # on_conn_close while serving a request on that connection) —
+        # how the head ties client-session state to connection lifetime
+        self._conn_cleanups: dict = {}
+        self._tls = threading.local()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -56,6 +61,19 @@ class RpcServer:
 
     def add_handler(self, name: str, fn) -> None:
         self._handlers[name] = fn
+
+    def on_conn_close(self, callback) -> bool:
+        """Run ``callback()`` when the CURRENT request's connection
+        drops (clean close or network death).  Callable only from
+        inside a handler; returns False outside one."""
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            return False
+        with self._lock:
+            if conn not in self._conns:
+                return False    # already gone: run it now
+            self._conn_cleanups.setdefault(conn, []).append(callback)
+            return True
 
     # -- codec hooks (pickle protocol; overridden by the xlang gateway) ----
     def _recv_request(self, conn):
@@ -137,13 +155,20 @@ class RpcServer:
         finally:
             with self._lock:
                 self._conns.discard(conn)
+                cleanups = self._conn_cleanups.pop(conn, ())
             try:
                 conn.close()
             except OSError:
                 pass
+            for cb in cleanups:
+                try:
+                    cb()
+                except Exception:   # noqa: BLE001 — cleanup must not
+                    pass            # kill the conn reaper
 
     def _run_handler(self, conn, wlock, req_id, method, args,
                      kwargs) -> None:
+        self._tls.conn = conn
         try:
             fn = self._handlers.get(method)
             if fn is None:
@@ -152,6 +177,8 @@ class RpcServer:
             ok, payload = True, result
         except BaseException as e:     # noqa: BLE001 — typed error reply
             ok, payload = False, self._error_payload(e)
+        finally:
+            self._tls.conn = None
         try:
             data = self._encode_reply(req_id, ok, payload)
         except Exception as e:          # result outside the codec's subset

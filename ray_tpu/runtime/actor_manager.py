@@ -400,6 +400,15 @@ class ActorManager:
                     status=kind, trace_id=call.trace_ctx[0],
                     parent_id=call.trace_ctx[1],
                     span_id=call.task_id.hex())
+            contained = msg[3] if len(msg) > 3 else None
+            if contained and kind in ("actor_result", "actor_result_x"):
+                # refs pickled inside the results stay alive until the
+                # enclosing return object is reclaimed (borrow-on-return)
+                for i, inner in enumerate(contained):
+                    if inner:
+                        self._cluster.ref_counter.add_contained(
+                            ObjectID.for_task_return(call.task_id, i + 1),
+                            [ObjectID(b) for b in inner])
             if kind == "actor_result":
                 row = rec.row if rec is not None else -1
                 for i, data in enumerate(msg[2]):

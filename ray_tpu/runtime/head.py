@@ -91,6 +91,8 @@ class HeadNode:
             "get_actor_by_name": self._get_actor_by_name,
             "cancel": self._cancel,
             "kv": self._kv,
+            "refs_flush": self._refs_flush,
+            "client_bye": self._client_bye,
             "status": self._status,
             "nodes": self._nodes,
             "available_resources": self._available_resources,
@@ -113,25 +115,45 @@ class HeadNode:
     def _connect(self, job_runtime_env: dict | None) -> dict:
         """A client attaches: allocate it a job id; a job-level env from
         the FIRST env-bearing client becomes the cluster default (one
-        shared job env — the in-process simplification)."""
+        shared job env — the in-process simplification).  The client
+        becomes a refcount HOLDER tied to this connection: its batched
+        ref events fold under ("c", job) and a disconnect — graceful or
+        abrupt — retires every count it held, so concurrent drivers have
+        disjoint object lifetimes."""
         job_id = JobID.next()
         with self._lock:    # check-then-set: FIRST env-bearing client
             if job_runtime_env and not self._rt.cluster.job_runtime_env:
                 self._rt.cluster.job_runtime_env = job_runtime_env
+        counter = self._rt.cluster.ref_counter
+        self.server.on_conn_close(
+            lambda: counter.holder_gone(("c", job_id.binary())))
         return {"job_id": job_id.binary(),
                 "session_dir": self._rt.cluster.session_dir}
+
+    def _refs_flush(self, job_bin: bytes, events: list) -> None:
+        self._rt.cluster.ref_counter.apply_batch(events, ("c", job_bin))
+
+    def _client_bye(self, job_bin: bytes) -> None:
+        self._rt.cluster.ref_counter.holder_gone(("c", job_bin))
 
     def _fn_register(self, fn_id: str, fn_bytes: bytes) -> None:
         self._rt.fn_registry.setdefault(fn_id, fn_bytes)
 
     def _submit_spec(self, spec_bytes: bytes, fn_id: str,
-                     fn_bytes: bytes | None) -> None:
+                     fn_bytes: bytes | None,
+                     job_bin: bytes | None = None) -> None:
         from .object_ref import counter_suppressed
         # suppressed: counted server-side twins of the client's refs
         # would decref to zero on lineage eviction and reclaim objects
         # the client still holds (see counter_suppressed docstring)
         with counter_suppressed():
             spec = deserialize(spec_bytes)
+        if job_bin is not None:
+            counter = self._rt.cluster.ref_counter
+            for i in range(spec.num_returns):
+                counter.set_owner(
+                    ObjectID.for_task_return(spec.task_id, i + 1),
+                    ("c", job_bin))
         self._rt.submit_spec(spec, fn_id, fn_bytes)
 
     def _get(self, oid_bins: list[bytes], timeout: float | None):
@@ -141,8 +163,19 @@ class HeadNode:
         except BaseException as e:      # noqa: BLE001 — typed re-raise
             return ("exc", serialize(e))    # client-side
 
-    def _put(self, value_bytes: bytes) -> bytes:
-        return self._rt.put_raw(deserialize(value_bytes)).binary()
+    def _put(self, value_bytes: bytes, job_bin: bytes | None = None,
+             contained: list | None = None) -> bytes:
+        from .object_ref import counter_suppressed
+        with counter_suppressed():      # see _submit_spec
+            value = deserialize(value_bytes)
+        oid = self._rt.put_raw(value)
+        counter = self._rt.cluster.ref_counter
+        if job_bin is not None:
+            counter.set_owner(oid, ("c", job_bin))
+        if contained:
+            counter.add_contained(oid,
+                                  [ObjectID(b) for b in contained])
+        return oid.binary()
 
     def _wait(self, oid_bins: list[bytes], num_returns: int,
               timeout: float | None):

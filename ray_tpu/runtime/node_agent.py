@@ -282,7 +282,8 @@ class NodeAgent:
                     # store-full in-band fallback: bytes ride up
                 descs.append(("v", data))
             if any_big:
-                return (kind + "_x", msg[1], descs)
+                # trailing elements (contained-ref lists) pass through
+                return (kind + "_x", msg[1], descs) + tuple(msg[3:])
             return msg
         if kind in ("error", "actor_error"):
             self._release_exec_pins(index, msg[1])
@@ -293,7 +294,7 @@ class NodeAgent:
                 self.store.put_serialized(oid, msg[2])
                 k, size = self.store.plasma_info(oid)
                 if k in ("shm", "spill"):
-                    return ("put_x", msg[1], size)
+                    return ("put_x", msg[1], size) + tuple(msg[3:])
             return msg
         if kind == "get_ack":
             with self._pin_lock:

@@ -22,12 +22,25 @@ from ..common.ids import ObjectID
 
 _counter = None     # the owner-process ReferenceCounter, or None
 _suppress = threading.local()   # per-thread: refs built uncounted
+_collect = threading.local()    # per-thread: refs pickled inside a payload
 
 
 def install_counter(counter) -> None:
     """Make new ObjectRefs in this process count against ``counter``."""
     global _counter
     _counter = counter
+
+
+def install_counter_if_absent(counter) -> bool:
+    """Install only when no counter is active.  A ClientRuntime created
+    INSIDE a process that already counts (the head, a worker) must not
+    steal that process's refs — they keep their original holder and the
+    embedded client rides that lifetime."""
+    global _counter
+    if _counter is not None:
+        return False
+    _counter = counter
+    return True
 
 
 def uninstall_counter(counter) -> None:
@@ -52,6 +65,34 @@ def counter_suppressed():
         yield
     finally:
         _suppress.on = prev
+
+
+@contextlib.contextmanager
+def ref_collector():
+    """Record every ObjectRef pickled on THIS thread inside the block.
+
+    Serializing a result/put payload under this yields the ids of the
+    refs nested in it; the head registers them as CONTAINED in the
+    enclosing object, which keeps them alive until it is reclaimed —
+    closing the window where the producer's refs die before the
+    consumer deserializes (upstream: ownership info travels with the
+    serialized ref)."""
+    prev = getattr(_collect, "refs", None)
+    _collect.refs = []
+    try:
+        yield _collect.refs
+    finally:
+        _collect.refs = prev
+
+
+def serialize_collecting(value) -> tuple[bytes, list[bytes]]:
+    """Serialize ``value`` and return (payload, binary ids of every
+    ObjectRef pickled inside it) — the shared form of the
+    seal-with-containment pattern used by puts and result payloads."""
+    from .serialization import serialize
+    with ref_collector() as got:
+        data = serialize(value)
+    return data, [o.binary() for o in got]
 
 
 class ObjectRef:
@@ -86,6 +127,9 @@ class ObjectRef:
         return self._id.task_id()
 
     def __reduce__(self):
+        got = getattr(_collect, "refs", None)
+        if got is not None:
+            got.append(self._id)
         return (ObjectRef, (self._id,))
 
     def __eq__(self, other):

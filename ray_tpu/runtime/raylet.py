@@ -1272,8 +1272,12 @@ class Raylet:
                 # — marking done first opens a window where the counter
                 # concludes the object will never seal and leaks it
                 if kind == "result":
+                    self._seal_contained(rec, msg[3] if len(msg) > 3
+                                         else None)
                     self._seal_results(rec, msg[2])
                 elif kind == "result_x":
+                    self._seal_contained(rec, msg[3] if len(msg) > 3
+                                         else None)
                     self._seal_results_x(rec, msg[2])
                 else:
                     err = deserialize(msg[2])
@@ -1362,13 +1366,24 @@ class Raylet:
                             o, size, self.row, PullPriority.WAIT)
             worker.send(("wait_reply",
                          serialize([o.binary() for o in ready])))
+        elif kind == "refs":
+            # this worker's batched local incref/decref events fold
+            # against its holder entry (distributed refcounting)
+            self.cluster.ref_counter.apply_batch(msg[1],
+                                                 self._holder_of(worker))
         elif kind == "put":
             oid = self._oid(msg[1])
+            self._register_contained(oid, msg[3] if len(msg) > 3 else ())
+            self.cluster.ref_counter.set_owner(oid,
+                                              self._holder_of(worker))
             self.cluster.seal_serialized(oid, msg[2], self.row)
         elif kind == "put_x":
             # a plane agent already sealed the put payload into its own
             # arena: record metadata only (location before seal)
             oid = self._oid(msg[1])
+            self._register_contained(oid, msg[3] if len(msg) > 3 else ())
+            self.cluster.ref_counter.set_owner(oid,
+                                              self._holder_of(worker))
             self.cluster.directory.add_location(oid, self.row)
             self.store.put_remote(oid, msg[2])
         elif kind == "submit":
@@ -1376,12 +1391,16 @@ class Raylet:
             fn_id, fn_bytes = msg[2], msg[3]
             if fn_bytes is not None and fn_id not in self._fn_registry:
                 self._fn_registry[fn_id] = fn_bytes
-            # no driver-side ObjectRefs for the results: the only live
-            # refs are in the submitting WORKER process, which is outside
-            # the owner counter — counted transients here would reclaim
-            # results the worker still needs.  Worker-held objects are
-            # simply never auto-reclaimed (conservative leak, reference
-            # borrower protocol's in-process simplification).
+            # no driver-side ObjectRefs for the results: the live refs
+            # are in the submitting WORKER process, whose own counter
+            # streams them here against its holder entry ("refs"
+            # frames) — the worker owns these returns and its holder
+            # keeps them alive until its refs die (or it does)
+            from ..common.ids import ObjectID as _OID
+            holder = self._holder_of(worker)
+            for i in range(spec.num_returns):
+                self.cluster.ref_counter.set_owner(
+                    _OID.for_task_return(spec.task_id, i + 1), holder)
             parent_env = self._parent_env_of(worker)
             if parent_env:
                 # children inherit their PARENT task/actor's env, not
@@ -1413,6 +1432,25 @@ class Raylet:
             except Exception as e:      # noqa: BLE001
                 worker.send(("kv_reply", None,
                              f"{type(e).__name__}: {e}"))
+
+    def _holder_of(self, worker: WorkerHandle) -> tuple:
+        """This worker process's refcount holder key (pool indexes are
+        monotonic, so the key is never reused on this raylet)."""
+        return ("w", self.row, worker.index)
+
+    def _register_contained(self, parent, contained_bins) -> None:
+        if contained_bins:
+            self.cluster.ref_counter.add_contained(
+                parent, [self._oid(b) for b in contained_bins])
+
+    def _seal_contained(self, rec, contained) -> None:
+        """Refs pickled inside result payloads stay alive until the
+        enclosing return object is reclaimed (borrow-on-return)."""
+        if not contained:
+            return
+        for oid, inner in zip(rec.return_ids, contained):
+            if inner and oid not in rec.dead_returns:
+                self._register_contained(oid, inner)
 
     def _seal_results(self, rec, payloads) -> None:
         """Seal a task's serialized return payloads (size-routed, with
@@ -1564,6 +1602,8 @@ class Raylet:
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
         self._drain_worker_pins(worker)
+        # fate-sharing: every ref this worker process held dies with it
+        self.cluster.ref_counter.holder_gone(self._holder_of(worker))
         # not-yet-sent pipelined tasks were never at risk: requeue them
         self._recall_assigned(worker, to_global=True)
 
@@ -1689,6 +1729,7 @@ class Raylet:
             workers = list(self.pool._workers)
         for w in workers:
             self._drain_worker_pins(w)
+            self.cluster.ref_counter.holder_gone(self._holder_of(w))
         for task_id in queued:
             fallback.enqueue_forwarded(task_id)
         for _bin, (task_id, _w, pinned) in running:

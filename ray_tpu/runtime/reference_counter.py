@@ -1,21 +1,40 @@
-"""Owner-side reference counting: out-of-scope objects are reclaimed.
+"""Distributed reference counting: per-holder counts, owners, borrows.
 
 Reference parity: the core worker's ``ReferenceCounter`` (``src/ray/
-core_worker/reference_count.cc``) tracks local refs per ObjectRef (Python
-``__del__``/pickle hooks) plus submitted-task dependencies, and drives
-object deletion when counts hit zero; lineage stays pinned while
-reconstruction might need it (SURVEY.md §1 layer 7, §5.3; mount empty).
+core_worker/reference_count.cc``) tracks local refs per process plus
+borrower registrations, and the object's OWNER decides deletion;
+lineage stays pinned while reconstruction might need it (SURVEY.md §1
+layer 7, §5.3; mount empty).
 
-In-process form: the driver is the owner of every object, so one counter
-covers the cluster.  Task-arg borrows need no protocol — the retained
-``TaskSpec`` in the TaskManager holds the arg ObjectRefs (strong Python
-references), so an in-flight or lineage-pinned task keeps its deps alive
-and eviction of lineage cascades naturally through ``__del__``.
+The rebuild's shape: every ref-holding process (the driver, each worker
+process, each attached client) counts its OWN refs and streams batched
+incref/decref events to this table at the head — workers over their
+pipe (``("refs", …)`` frames), clients over RPC (``refs_flush``).  The
+head folds them per HOLDER, so one process's churn never corrupts
+another's view, and a holder's death (worker crash, client disconnect)
+retires all its counts at once — the fate-sharing upstream gets from
+per-worker ownership.  An object stays alive while ANY holder counts it
+(a worker that stashes a borrowed ref keeps the object alive after the
+owner's task returns — the borrow semantics of upstream's protocol,
+with the bookkeeping centralized in the GCS process like everything
+else in this design).
 
-``__del__`` safety: ref events are appended to a lock-free deque (atomic
-in CPython) and folded by a dedicated reclaimer thread — ``__del__`` can
-fire at any allocation point, including inside store/raylet critical
-sections, so it must never take foreign locks.
+Owner stamping: each object records the holder that created it (task
+submitter / putter).  Divergence from upstream, documented: owner death
+does NOT invalidate the object — the store and this table live in the
+head, so surviving holders keep using it (upstream loses the object
+because its metadata dies with the owning worker; ours doesn't).
+
+Containment: a sealed result/put payload that has ObjectRefs pickled
+inside it holds those inner objects alive until the ENCLOSING object is
+reclaimed — closing the window where the producer's refs die before the
+consumer deserializes (upstream closes it with ownership-transfer
+handshakes on the serialized ref).
+
+``__del__`` safety: ref events are appended to a lock-free deque
+(atomic in CPython) and folded by a dedicated reclaimer thread —
+``__del__`` can fire at any allocation point, including inside
+store/raylet critical sections, so it must never take foreign locks.
 """
 
 from __future__ import annotations
@@ -25,12 +44,25 @@ from collections import deque
 
 from ..common.ids import ObjectID
 
+DRIVER = ("drv",)               # default holder: the driver process
+
 
 class ReferenceCounter:
     def __init__(self):
-        self._events: deque = deque()       # (+1 | -1, ObjectID)
+        self._events: deque = deque()
         self._wake = threading.Event()
-        self._counts: dict[ObjectID, int] = {}
+        # oid -> {holder: count}; an oid is live while any count > 0
+        self._counts: dict[ObjectID, dict] = {}
+        self._by_holder: dict[tuple, set] = {}      # holder -> oids
+        self._owner: dict[ObjectID, tuple] = {}
+        self._owned_by: dict[tuple, set] = {}       # holder -> owned oids
+        # retired holders: ids are never reused (client job ids and
+        # worker pool indexes are monotonic), so a tombstone safely
+        # drops events that raced the holder's death — a late
+        # refs_flush folding after holder_gone must not resurrect
+        # counts nothing will ever retire
+        self._dead_holders: set[tuple] = set()
+        self._contained: dict[ObjectID, tuple] = {}  # parent -> inner oids
         self._zero: set[ObjectID] = set()   # count hit 0, awaiting seal
         self._pinned: set[ObjectID] = set()
         self._reclaim = None                # callback(oid): free the object
@@ -41,11 +73,11 @@ class ReferenceCounter:
         self._thread: threading.Thread | None = None
 
     # -- hot path (any thread, __del__-safe: no locks) -----------------------
-    def incref(self, object_id: ObjectID) -> None:
-        self._events.append((1, object_id))
+    def incref(self, object_id: ObjectID, holder: tuple = DRIVER) -> None:
+        self._events.append(("+", object_id, holder))
 
-    def decref(self, object_id: ObjectID) -> None:
-        self._events.append((-1, object_id))
+    def decref(self, object_id: ObjectID, holder: tuple = DRIVER) -> None:
+        self._events.append(("-", object_id, holder))
         # wake on the empty->non-empty transition or a deep backlog: a
         # burst of dying refs (tiny-task storms) must not ping-pong the
         # GIL between this thread and the reclaimer once per event, and
@@ -55,12 +87,37 @@ class ReferenceCounter:
         if n == 1 or n >= 256:
             self._wake.set()
 
+    def apply_batch(self, events, holder: tuple) -> None:
+        """Fold a remote holder's batched (+1|-1, oid_bin) events —
+        workers' ``("refs", …)`` frames and clients' ``refs_flush``."""
+        for delta, oid_bin in events:
+            self._events.append(("+" if delta > 0 else "-",
+                                 ObjectID(oid_bin), holder))
+        self._wake.set()
+
     # -- pinning (PG ready markers etc. are never reclaimed) -----------------
     def pin(self, object_id: ObjectID) -> None:
-        self._events.append((0, object_id))
+        self._events.append(("p", object_id, None))
 
     def unpin(self, object_id: ObjectID) -> None:
-        self._events.append((2, object_id))
+        self._events.append(("u", object_id, None))
+        self._wake.set()
+
+    # -- ownership / containment / holder lifecycle --------------------------
+    def set_owner(self, object_id: ObjectID, holder: tuple) -> None:
+        self._events.append(("o", object_id, holder))
+
+    def add_contained(self, parent: ObjectID, inner) -> None:
+        """Inner refs pickled inside ``parent``'s sealed payload: each
+        stays alive until the parent is reclaimed."""
+        if inner:
+            self._events.append(("c", parent, tuple(inner)))
+            self._wake.set()
+
+    def holder_gone(self, holder: tuple) -> None:
+        """A ref-holding process died/disconnected: retire every count
+        it held (objects only it referenced become reclaimable)."""
+        self._events.append(("g", None, holder))
         self._wake.set()
 
     # -- lifecycle -----------------------------------------------------------
@@ -93,69 +150,163 @@ class ReferenceCounter:
             self._wake.clear()
             self.flush()
 
+    def _total(self, oid: ObjectID) -> int:
+        return sum(self._counts.get(oid, {}).values())
+
+    def _bump(self, oid: ObjectID, holder: tuple, delta: int,
+              dead: list) -> None:
+        holders = self._counts.get(oid)
+        if holders is None:
+            holders = self._counts[oid] = {}
+        c = holders.get(holder, 0) + delta
+        if c != 0:
+            holders[holder] = c
+            self._by_holder.setdefault(holder, set()).add(oid)
+        else:
+            holders.pop(holder, None)
+            hset = self._by_holder.get(holder)
+            if hset is not None:
+                hset.discard(oid)
+                if not hset:
+                    del self._by_holder[holder]
+        total = sum(holders.values())
+        if total > 0:
+            self._zero.discard(oid)
+        else:
+            if not holders:
+                del self._counts[oid]
+            dead.append(oid)
+
     def flush(self) -> None:
         """Fold queued events and reclaim newly dead objects.  Runs on the
-        reclaimer thread (tests may call it directly for determinism)."""
-        dead = []
+        reclaimer thread (tests may call it directly for determinism).
+        Loops until both the queue and the dead list drain: reclaiming a
+        parent enqueues decrefs for its contained refs."""
         while True:
-            try:
-                delta, oid = self._events.popleft()
-            except IndexError:
-                break
-            if delta == 0:
-                self._pinned.add(oid)
+            dead = []
+            processed = False
+            while True:
+                try:
+                    kind, oid, arg = self._events.popleft()
+                except IndexError:
+                    break
+                processed = True
+                if kind == "+":
+                    if arg not in self._dead_holders:
+                        self._bump(oid, arg, 1, dead)
+                elif kind == "-":
+                    if arg not in self._dead_holders:
+                        self._bump(oid, arg, -1, dead)
+                elif kind == "p":
+                    self._pinned.add(oid)
+                elif kind == "u":
+                    self._pinned.discard(oid)
+                    if self._total(oid) <= 0:
+                        dead.append(oid)
+                elif kind == "r":   # recheck-after-seal (deferred)
+                    self._reclaim_if_still_dead(oid)
+                elif kind == "o":
+                    self._owner[oid] = arg
+                    self._owned_by.setdefault(arg, set()).add(oid)
+                elif kind == "c":
+                    # the parent holds its pickled-inside refs alive
+                    holder = ("obj", oid.binary())
+                    prev = self._contained.get(oid, ())
+                    self._contained[oid] = prev + arg
+                    for inner in arg:
+                        self._bump(inner, holder, 1, [])
+                elif kind == "g":
+                    self._retire_holder(arg, dead)
+            for oid in dead:
+                if oid in self._pinned or self._total(oid) > 0:
+                    continue
+                if self._contains is not None and \
+                        not self._contains(oid):
+                    if self._expects_seal is not None and \
+                            not self._expects_seal(oid):
+                        self._drop_owner(oid)
+                        self._release_contained(oid)
+                        continue    # absent, never sealing: nothing to free
+                    # unsealed (pending task output): reclaim when it
+                    # seals, unless a new reference appears first
+                    self._zero.add(oid)
+                    if self._on_ready is not None:
+                        self._on_ready(oid, self._recheck_on_seal)
+                    continue
+                self._do_reclaim(oid)
+            if not processed and not self._events:
+                return
+
+    def _retire_holder(self, holder: tuple, dead: list) -> None:
+        self._dead_holders.add(holder)
+        for oid in list(self._by_holder.get(holder, ())):
+            holders = self._counts.get(oid)
+            if holders is None:
                 continue
-            if delta == 2:
-                self._pinned.discard(oid)
-                if self._counts.get(oid, 0) <= 0:
-                    dead.append(oid)
-                continue
-            if delta == 3:      # recheck-after-seal (deferred reclaim)
-                self._reclaim_if_still_dead(oid)
-                continue
-            c = self._counts.get(oid, 0) + delta
-            if c > 0:
-                self._counts[oid] = c
-                self._zero.discard(oid)
-            else:
-                self._counts.pop(oid, None)
+            holders.pop(holder, None)
+            if not holders:
+                del self._counts[oid]
                 dead.append(oid)
-        for oid in dead:
-            if oid in self._pinned or self._counts.get(oid, 0) > 0:
-                continue
-            if self._contains is not None and not self._contains(oid):
-                if self._expects_seal is not None and \
-                        not self._expects_seal(oid):
-                    continue    # absent and never sealing: nothing to free
-                # unsealed (pending task output): reclaim when it seals,
-                # unless a new reference appears first
-                self._zero.add(oid)
-                if self._on_ready is not None:
-                    self._on_ready(oid, self._recheck_on_seal)
-                continue
-            if self._reclaim is not None:
-                self._reclaim(oid)
+            elif sum(holders.values()) <= 0:
+                dead.append(oid)
+        self._by_holder.pop(holder, None)
+        # objects OWNED by the dead holder with no counts from anyone
+        # (e.g. a client that vanished before its first flush, a worker
+        # whose events died in the pipe) die with it — otherwise they
+        # are unreachable forever
+        for oid in self._owned_by.get(holder, ()):
+            if self._total(oid) <= 0:
+                dead.append(oid)
+
+    def _drop_owner(self, oid: ObjectID) -> None:
+        owner = self._owner.pop(oid, None)
+        if owner is not None:
+            oset = self._owned_by.get(owner)
+            if oset is not None:
+                oset.discard(oid)
+                if not oset:
+                    del self._owned_by[owner]
+
+    def _do_reclaim(self, oid: ObjectID) -> None:
+        self._drop_owner(oid)
+        self._release_contained(oid)
+        if self._reclaim is not None:
+            self._reclaim(oid)
+
+    def _release_contained(self, oid: ObjectID) -> None:
+        inner = self._contained.pop(oid, None)
+        if inner:
+            holder = ("obj", oid.binary())
+            for child in inner:
+                self._events.append(("-", child, holder))
 
     def _recheck_on_seal(self, oid: ObjectID) -> None:
         """Seal callback for a deferred reclaim: routed through the event
         queue (not decided inline) so any incref already queued when the
         object seals folds FIRST — deciding here could reclaim an object
         whose new reference is still in flight."""
-        self._events.append((3, oid))
+        self._events.append(("r", oid, None))
         self._wake.set()
 
     def _reclaim_if_still_dead(self, oid: ObjectID) -> None:
         if oid in self._zero and oid not in self._pinned \
-                and self._counts.get(oid, 0) <= 0:
+                and self._total(oid) <= 0:
             self._zero.discard(oid)
-            if self._reclaim is not None:
-                self._reclaim(oid)
+            self._do_reclaim(oid)
 
     # -- introspection -------------------------------------------------------
     def count_of(self, object_id: ObjectID) -> int:
-        return self._counts.get(object_id, 0)
+        return self._total(object_id)
+
+    def owner_of(self, object_id: ObjectID) -> tuple | None:
+        return self._owner.get(object_id)
+
+    def holders_of(self, object_id: ObjectID) -> dict:
+        return dict(self._counts.get(object_id, {}))
 
     def stats(self) -> dict:
         return {"num_tracked": len(self._counts),
                 "num_pinned": len(self._pinned),
+                "num_holders": len(self._by_holder),
+                "num_owned": len(self._owner),
                 "queued_events": len(self._events)}

@@ -45,6 +45,36 @@ class ArgRef:
         return (ArgRef, (self.desc,))
 
 
+class WorkerRefCounter:
+    """This worker process's share of distributed refcounting: local
+    ObjectRef construction/destruction queue here (``__del__``-safe,
+    lock-free) and batches ship to the raylet as ``("refs", …)`` frames,
+    where they fold against this worker's HOLDER entry in the head's
+    ReferenceCounter.  A stashed borrowed ref therefore keeps its object
+    alive after the lending task returns; worker death retires the whole
+    holder (reference: per-worker ReferenceCounter + borrower protocol,
+    SURVEY.md §1 layer 7; mount empty)."""
+
+    def __init__(self):
+        from collections import deque
+        self._events: deque = deque()
+
+    def incref(self, object_id) -> None:
+        self._events.append((1, object_id))
+
+    def decref(self, object_id) -> None:
+        self._events.append((-1, object_id))
+
+    def drain(self) -> list:
+        out = []
+        while True:
+            try:
+                delta, oid = self._events.popleft()
+            except IndexError:
+                return out
+            out.append((delta, oid.binary()))
+
+
 class WorkerApiContext:
     """The in-worker implementation of the public API (get/put/submit).
 
@@ -60,11 +90,19 @@ class WorkerApiContext:
         self._put_index = 0
         self._arena_path = arena_path
         self._arena = None          # lazily attached, read-only
+        self.ref_counter = WorkerRefCounter()
         # frames that arrived while this worker was waiting for a reply
         # (pipelined actor calls land mid-get); the main loop drains them
         # in order after the current task finishes
         from collections import deque
         self.pending_frames = deque()
+
+    def flush_refs(self) -> None:
+        """Ship queued local ref events to the raylet (called at frame
+        boundaries; FIFO on the pipe keeps per-holder event order)."""
+        events = self.ref_counter.drain()
+        if events:
+            self._conn.send(("refs", events))
 
     def _materialize(self, desc, extern=None):
         """Resolve a descriptor: in-band value ("v"), in-band serialized
@@ -139,7 +177,10 @@ class WorkerApiContext:
         assert self._task_id is not None, "put outside a task"
         self._put_index += 1
         oid = ObjectID.for_put(self._task_id, self._put_index)
-        self._conn.send(("put", oid.binary(), serialize(value)))
+        from .object_ref import serialize_collecting
+        data, contained = serialize_collecting(value)
+        self.flush_refs()
+        self._conn.send(("put", oid.binary(), data, contained))
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):
@@ -218,6 +259,8 @@ def worker_main(conn, worker_index: int,
 
     ctx = WorkerApiContext(conn, arena_path)
     api._set_runtime(ctx)
+    from .object_ref import install_counter, serialize_collecting
+    install_counter(ctx.ref_counter)
     fn_table: dict[str, object] = {}
     actor_instance = None            # dedicated worker: one actor
     actor_id_bin = None
@@ -267,8 +310,12 @@ def worker_main(conn, worker_index: int,
                             f"task {name} declared num_returns="
                             f"{num_returns} but returned {len(results)} "
                             "values")
-                conn.send(("result", task_id_bin,
-                           [serialize(r) for r in results]))
+                payloads, contained = [], []
+                for r in results:
+                    data, inner = serialize_collecting(r)
+                    payloads.append(data)
+                    contained.append(inner)
+                conn.send(("result", task_id_bin, payloads, contained))
             except BaseException as e:  # noqa: BLE001 — any task failure
                 err = RayTaskError.from_exception(name, e)
                 try:
@@ -280,6 +327,11 @@ def worker_main(conn, worker_index: int,
                 if _scope is not None:
                     _scope.__exit__(None, None, None)
                 ctx.end_task()
+                # task locals must die NOW, not when the next exec
+                # overwrites these loop variables — their ObjectRefs'
+                # decrefs ride the flush below ("r" is the serialization
+                # loop variable, still bound to the last result)
+                args = kwargs = out = results = payloads = r = None
         elif kind == "actor_new":
             _, actor_id_bin, cls_id, payload = msg
             args, kwargs = deserialize(payload)
@@ -325,8 +377,13 @@ def worker_main(conn, worker_index: int,
                             f"actor method {method} declared num_returns="
                             f"{num_returns} but returned {len(results)} "
                             "values")
-                conn.send(("actor_result", task_id_bin,
-                           [serialize(r) for r in results]))
+                payloads, contained = [], []
+                for r in results:
+                    data, inner = serialize_collecting(r)
+                    payloads.append(data)
+                    contained.append(inner)
+                conn.send(("actor_result", task_id_bin, payloads,
+                           contained))
             except BaseException as e:  # noqa: BLE001
                 conn.send(("actor_error", task_id_bin, serialize(
                     RayTaskError.from_exception(method, e))))
@@ -334,7 +391,15 @@ def worker_main(conn, worker_index: int,
                 if _scope is not None:
                     _scope.__exit__(None, None, None)
                 ctx.end_task()
+                # call locals die now (see the exec branch)
+                args = kwargs = out = results = payloads = r = None
         elif kind == "shutdown":
+            break
+        # ship ref events born while handling this frame (task locals
+        # died, results built refs) — per-holder order rides the pipe
+        try:
+            ctx.flush_refs()
+        except (OSError, BrokenPipeError):
             break
     sys.exit(0)
 

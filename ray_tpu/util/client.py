@@ -8,9 +8,12 @@ empty).  Here the proxy speaks ``ray_tpu.rpc`` to ``runtime/head.py``.
 The ClientRuntime presents the WORKER-context surface (``is_driver``
 False): ``RemoteFunction.remote``/``ActorClass.remote`` take their
 non-driver path, deriving task ids from a synthetic driver task id under
-the server-assigned job id.  Objects the client holds are never counted
-on the server (worker-frame "conservative leak" ownership — see
-``runtime/head.py``).
+the server-assigned job id.  The client is a refcount HOLDER: its local
+ObjectRef events batch to the head (``refs_flush``, piggybacked on the
+next RPC) and fold under ``("c", job_id)``; disconnect — graceful
+``client_bye`` or an abrupt connection drop — retires every count it
+held, so two concurrent drivers on one head have disjoint object
+lifetimes (reference: per-process ownership, SURVEY.md §1 layer 7).
 """
 
 from __future__ import annotations
@@ -48,6 +51,13 @@ class ClientRuntime:
         self.address = address
         self._rpc = RpcClient(address)
         self._lock = threading.Lock()
+        # this process's share of distributed refcounting: ObjectRefs
+        # built here count locally; batches ship ahead of the next RPC
+        # (constructed BEFORE the first _call — it flushes through this)
+        from ..runtime.object_ref import install_counter_if_absent
+        from ..runtime.worker import WorkerRefCounter
+        self.ref_counter = WorkerRefCounter()
+        self._refs_lock = threading.Lock()
         info = self._call("connect", runtime_env)
         from ..common.ids import JobID
         self.job_id = JobID(info["job_id"])
@@ -55,13 +65,32 @@ class ClientRuntime:
         # non-driver submission paths derive ids from current_task_id
         self.current_task_id = TaskID.for_task(self.job_id)
         self.fn_registry = _RemoteFnRegistry(self)
+        # no-op when this process already counts (embedded client in a
+        # head/worker process: refs keep their original holder)
+        self._counter_installed = \
+            install_counter_if_absent(self.ref_counter)
 
     def _call(self, method: str, *args, **kwargs):
+        self._flush_refs()
         return self._rpc.call(method, *args, **kwargs)
+
+    def _flush_refs(self) -> None:
+        # one flusher at a time: interleaved drains could split a +/-
+        # pair across two batches whose handler threads race server-side
+        # (the synchronous call also serializes batch arrival order)
+        with self._refs_lock:
+            events = self.ref_counter.drain()
+            if events:
+                try:
+                    self._rpc.call("refs_flush", self.job_id.binary(),
+                                   events)
+                except Exception:   # noqa: BLE001 — conn gone: the
+                    pass            # server's close hook retires us
 
     # -- core API (the surface api.py/actor_api.py dispatch to) --------------
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None) -> None:
-        self._call("submit_spec", serialize(spec), fn_id, fn_bytes)
+        self._call("submit_spec", serialize(spec), fn_id, fn_bytes,
+                   self.job_id.binary())
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
         kind, payload = self._call(
@@ -73,7 +102,10 @@ class ClientRuntime:
         return result
 
     def put(self, value) -> ObjectRef:
-        oid_bin = self._call("put", serialize(value))
+        from ..runtime.object_ref import serialize_collecting
+        data, contained = serialize_collecting(value)
+        oid_bin = self._call("put", data, self.job_id.binary(),
+                             contained)
         return ObjectRef(ObjectID(oid_bin))
 
     def wait(self, refs, num_returns, timeout):
@@ -131,6 +163,15 @@ class ClientRuntime:
         return self._call("status")
 
     def close(self) -> None:
+        from ..runtime.object_ref import uninstall_counter
+        self._flush_refs()
+        try:
+            self._rpc.call("client_bye", self.job_id.binary(),
+                           timeout=5.0)
+        except Exception:       # noqa: BLE001 — head already gone; its
+            pass                # conn-close hook retires this holder
+        if self._counter_installed:
+            uninstall_counter(self.ref_counter)
         self._rpc.close()
 
 
