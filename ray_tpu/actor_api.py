@@ -25,15 +25,21 @@ def _runtime():
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._group = concurrency_group
 
-    def options(self, *, num_returns: int | None = None) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name,
-                           num_returns if num_returns is not None
-                           else self._num_returns)
+    def options(self, *, num_returns: int | None = None,
+                concurrency_group: str | None = None) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._name,
+            num_returns if num_returns is not None
+            else self._num_returns,
+            concurrency_group if concurrency_group is not None
+            else self._group)
 
     def remote(self, *args, **kwargs):
         from .util.tracing import context_for_new_task
@@ -45,10 +51,12 @@ class ActorMethod:
         if rt.is_driver:
             rt.actor_manager.submit(actor_id, task_id, self._name, args,
                                     kwargs, self._num_returns,
-                                    trace_ctx=trace_ctx)
+                                    trace_ctx=trace_ctx,
+                                    concurrency_group=self._group)
         else:
             rt.submit_actor_call(actor_id, task_id, self._name, args,
-                                 kwargs, self._num_returns, trace_ctx)
+                                 kwargs, self._num_returns, trace_ctx,
+                                 concurrency_group=self._group)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
                 for i in range(self._num_returns)]
         return refs[0] if self._num_returns == 1 else refs
@@ -144,6 +152,25 @@ class ActorClass:
             res = shape_request(res, strategy.placement_group_id.hex(),
                                 strategy.bundle_index)
         resources = ResourceRequest(res)
+        # concurrency model (reference: max_concurrency for threaded
+        # actors — async actors default to 1000 worker-side — and named
+        # concurrency_groups with per-group limits)
+        concurrency = None
+        if opts.get("max_concurrency") or opts.get("concurrency_groups"):
+            concurrency = {
+                "max_concurrency": opts.get("max_concurrency"),
+                "concurrency_groups": opts.get("concurrency_groups"),
+            }
+        elif self._cls is not None:
+            # async actors default to max_concurrency=1000 (reference):
+            # detect here so the HEAD's pipelining window widens too —
+            # worker-side detection alone would cap effective
+            # concurrency at the default window
+            import inspect
+            if any(inspect.iscoroutinefunction(m) for _n, m in
+                   inspect.getmembers(self._cls) if callable(m)):
+                concurrency = {"max_concurrency": 1000,
+                               "concurrency_groups": None}
         cls_id, cls_bytes = self._materialize()
         if rt.is_driver:
             actor_id = ActorID.of(rt.job_id)
@@ -153,7 +180,8 @@ class ActorClass:
             actor_id = ActorID.of(job_id)
         rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
                         max_restarts, max_task_retries, name, resources,
-                        strategy, opts.get("runtime_env"))
+                        strategy, opts.get("runtime_env"),
+                        concurrency=concurrency)
         return ActorHandle(actor_id)
 
 

@@ -137,13 +137,14 @@ class DriverRuntime:
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
                      resources=None, strategy=None,
-                     runtime_env=None) -> None:
+                     runtime_env=None, concurrency=None) -> None:
         self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
                                         kwargs, max_restarts,
                                         max_task_retries, name,
                                         resources=resources,
                                         strategy=strategy,
-                                        runtime_env=runtime_env)
+                                        runtime_env=runtime_env,
+                                        concurrency=concurrency)
 
     def shutdown(self) -> None:
         # an adopted (caller-owned) cluster stays up across shutdown, the

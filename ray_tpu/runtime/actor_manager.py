@@ -53,6 +53,7 @@ class ActorCall:
     retries_left: int = 0
     trace_ctx: tuple | None = None      # (trace_id, parent_span)
     sent_at: float = 0.0                # span start (set at send)
+    group: str | None = None            # concurrency group
 
 
 @dataclass
@@ -68,6 +69,10 @@ class ActorRecord:
     strategy: SchedulingStrategy = field(
         default_factory=SchedulingStrategy)
     runtime_env: dict | None = None
+    # {"max_concurrency": N, "concurrency_groups": {name: n}} — ships
+    # to the worker's _ActorExecutor; widens the pipelining window so
+    # a concurrent actor actually receives overlapping calls
+    concurrency: dict | None = None
     state: ActorState = ActorState.PENDING
     worker = None
     pool = None                 # worker pool of the placement node
@@ -94,7 +99,8 @@ class ActorManager:
                      name: str | None = None,
                      resources: ResourceRequest | None = None,
                      strategy: SchedulingStrategy | None = None,
-                     runtime_env: dict | None = None) -> None:
+                     runtime_env: dict | None = None,
+                     concurrency: dict | None = None) -> None:
         if cls_bytes is not None:
             self._fn_registry.setdefault(cls_id, cls_bytes)
         from .runtime_env import merge_runtime_env
@@ -103,7 +109,8 @@ class ActorManager:
                           resources=resources or ResourceRequest(),
                           strategy=strategy or SchedulingStrategy(),
                           runtime_env=merge_runtime_env(
-                              self._cluster.job_runtime_env, runtime_env))
+                              self._cluster.job_runtime_env, runtime_env),
+                          concurrency=concurrency)
         rec.restarts_left = max_restarts
         with self._lock:
             if name is not None:
@@ -218,7 +225,7 @@ class ActorManager:
             rec.row = row
         try:
             payload = serialize((self._materialize_args(rec.init_args),
-                                 rec.init_kwargs))
+                                 rec.init_kwargs, rec.concurrency))
         except KeyError as e:
             # an init arg could not materialize at the head (its plane
             # pull failed / the object was reclaimed): fail the actor's
@@ -261,7 +268,8 @@ class ActorManager:
     # -- method submission --------------------------------------------------
     def submit(self, actor_id: ActorID, task_id: TaskID, method: str,
                args: tuple, kwargs: dict, num_returns: int,
-               trace_ctx: tuple | None = None) -> None:
+               trace_ctx: tuple | None = None,
+               concurrency_group: str | None = None) -> None:
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None or rec.state is ActorState.DEAD:
@@ -269,9 +277,21 @@ class ActorManager:
                 return
             call = ActorCall(task_id, method, args, kwargs, num_returns,
                              retries_left=rec.max_task_retries,
-                             trace_ctx=trace_ctx)
+                             trace_ctx=trace_ctx,
+                             group=concurrency_group)
             rec.queue.append(call)
         self._pump(actor_id)
+
+    @staticmethod
+    def _window(rec: ActorRecord) -> int:
+        """Pipelining window: a concurrent actor must RECEIVE overlapping
+        calls, so the window opens to its max_concurrency (plus group
+        capacity); plain actors keep the default pipeline depth."""
+        conc = rec.concurrency or {}
+        want = int(conc.get("max_concurrency") or 0)
+        want += sum(int(n) for n in
+                    (conc.get("concurrency_groups") or {}).values())
+        return max(_MAX_INFLIGHT, want)
 
     def _fail_call_ids(self, task_id: TaskID, num_returns: int,
                        actor_id: ActorID) -> None:
@@ -296,7 +316,8 @@ class ActorManager:
                 rec = self._actors.get(actor_id)
                 if rec is None or rec.state is not ActorState.ALIVE:
                     return
-                if not rec.queue or len(rec.inflight) >= _MAX_INFLIGHT:
+                if not rec.queue or \
+                        len(rec.inflight) >= self._window(rec):
                     return
                 call = rec.queue[0]
                 deps = [a.id for a in call.args
@@ -334,7 +355,8 @@ class ActorManager:
                 import time as _time
                 call.sent_at = _time.time()
                 payload = serialize((tuple(vals), call.kwargs,
-                                     call.num_returns, call.trace_ctx))
+                                     call.num_returns, call.trace_ctx,
+                                     call.group))
                 rec.worker.send(("actor_call", call.task_id.binary(),
                                  call.method, payload))
         # head has missing deps: wake the pump when they land

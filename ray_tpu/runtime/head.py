@@ -188,22 +188,34 @@ class HeadNode:
                       cls_bytes: bytes | None, payload: bytes) -> None:
         from .object_ref import counter_suppressed
         with counter_suppressed():      # see _submit_spec
+            unpacked = deserialize(payload)
+        if len(unpacked) == 9:
             (args, kwargs, max_restarts, max_task_retries, name, res,
-             strategy, runtime_env) = deserialize(payload)
+             strategy, runtime_env, concurrency) = unpacked
+        else:               # pre-concurrency client
+            (args, kwargs, max_restarts, max_task_retries, name, res,
+             strategy, runtime_env) = unpacked
+            concurrency = None
         self._rt.create_actor(ActorID(actor_bin), cls_id, cls_bytes,
                               args, kwargs, max_restarts,
                               max_task_retries, name, resources=res,
-                              strategy=strategy, runtime_env=runtime_env)
+                              strategy=strategy, runtime_env=runtime_env,
+                              concurrency=concurrency)
 
     def _submit_actor_call(self, actor_bin: bytes, task_bin: bytes,
                            method: str, payload: bytes,
                            num_returns: int) -> None:
         from .object_ref import counter_suppressed
         with counter_suppressed():      # see _submit_spec
-            args, kwargs, trace_ctx = deserialize(payload)
+            unpacked = deserialize(payload)
+        if len(unpacked) == 4:
+            args, kwargs, trace_ctx, group = unpacked
+        else:
+            args, kwargs, trace_ctx = unpacked
+            group = None
         self._rt.actor_manager.submit(
             ActorID(actor_bin), TaskID(task_bin), method, args, kwargs,
-            num_returns, trace_ctx=trace_ctx)
+            num_returns, trace_ctx=trace_ctx, concurrency_group=group)
 
     def _kill_actor(self, actor_bin: bytes, no_restart: bool) -> None:
         self._rt.actor_manager.kill(ActorID(actor_bin),

@@ -132,6 +132,22 @@ class ObjectRef:
             got.append(self._id)
         return (ObjectRef, (self._id,))
 
+    def __await__(self):
+        """``await ref`` inside an async actor resolves the object
+        (reference: ObjectRefs are awaitable in async actors).  The
+        blocking get runs on the loop's default executor so the event
+        loop keeps serving other coroutines."""
+        return self._resolve_async().__await__()
+
+    async def _resolve_async(self):
+        import asyncio
+        loop = asyncio.get_running_loop()
+
+        def blocking_get():
+            from .. import api
+            return api.get(self)
+        return await loop.run_in_executor(None, blocking_get)
+
     def __eq__(self, other):
         return isinstance(other, ObjectRef) and other._id == self._id
 

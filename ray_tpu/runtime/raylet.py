@@ -1215,8 +1215,14 @@ class Raylet:
                 return
             if kind == "actor_create":
                 from ..common.ids import ActorID
-                (args, kwargs, max_restarts, max_task_retries, name, res,
-                 strategy, runtime_env) = deserialize(msg[4])
+                unpacked = deserialize(msg[4])
+                if len(unpacked) == 9:
+                    (args, kwargs, max_restarts, max_task_retries, name,
+                     res, strategy, runtime_env, concurrency) = unpacked
+                else:       # pre-concurrency frame shape
+                    (args, kwargs, max_restarts, max_task_retries, name,
+                     res, strategy, runtime_env) = unpacked
+                    concurrency = None
                 parent_env = self._parent_env_of(worker)
                 if parent_env:
                     # worker-created actors inherit the creating
@@ -1227,13 +1233,20 @@ class Raylet:
                 am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
                                 kwargs, max_restarts, max_task_retries,
                                 name, resources=res, strategy=strategy,
-                                runtime_env=runtime_env)
+                                runtime_env=runtime_env,
+                                concurrency=concurrency)
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
-                args, kwargs, num_returns, trace_ctx = deserialize(msg[4])
+                unpacked = deserialize(msg[4])
+                if len(unpacked) == 5:
+                    args, kwargs, num_returns, trace_ctx, group = unpacked
+                else:
+                    args, kwargs, num_returns, trace_ctx = unpacked
+                    group = None
                 am.submit(ActorID(msg[1]), TaskID(msg[2]), msg[3], args,
-                          kwargs, num_returns, trace_ctx=trace_ctx)
+                          kwargs, num_returns, trace_ctx=trace_ctx,
+                          concurrency_group=group)
                 return
             if kind == "actor_kill":
                 from ..common.ids import ActorID

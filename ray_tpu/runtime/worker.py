@@ -3,32 +3,46 @@
 Reference parity: the worker side of upstream's core worker —
 ``CoreWorker::ExecuteTask`` receiving ``PushTask`` RPCs, with an in-worker
 API surface so user functions can call ``get/put/wait/.remote`` from inside
-a task (``src/ray/core_worker/``, SURVEY.md §3.2 tail; mount empty).
+a task, async actors running on an event loop, and threaded actors with
+bounded ``max_concurrency`` / concurrency groups
+(``src/ray/core_worker/``, SURVEY.md §1 layer 7, §3.2 tail; mount empty).
 
 Transport: one duplex ``multiprocessing`` connection to the owning raylet.
-The worker is single-threaded and synchronous: while it executes a task the
-only frames it can receive are replies to its own requests, so plain
-send/recv pairs are race-free without correlation ids.
+A dedicated READER thread owns ``conn.recv`` and routes frames: replies to
+this worker's own requests go to a reply queue (API calls are serialized
+by a lock, so exactly one is outstanding); work frames (exec, actor
+lifecycle) go to the work queue the main thread drains.  This is what
+lets concurrent actor calls block in ``ray.get`` independently — the
+reference gets the same property from the core worker's dedicated IO
+service thread.
 
 Frames (tuples, first element is the kind):
   raylet -> worker: ("fn", fn_id, bytes), ("exec", task_id_bin, fn_id,
-                    payload, trace_ctx), ("get_reply", payload),
+                    payload, trace_ctx, extern), ("get_reply*", ...),
                     ("wait_reply", payload), ("shutdown",)
-  worker -> raylet: ("ready",), ("result", task_id_bin, [bytes, ...]),
-                    ("error", task_id_bin, bytes), ("get", [oid_bin, ...]),
-                    ("wait", [oid_bin, ...], num_returns, timeout),
-                    ("put", oid_bin, bytes), ("submit", spec_bytes,
-                    fn_id, fn_bytes | None)
+  worker -> raylet: ("ready",), ("result", task_id_bin, [bytes, ...],
+                    contained), ("error", task_id_bin, bytes),
+                    ("get", [oid_bin, ...]), ("wait", ...),
+                    ("put", oid_bin, bytes, contained),
+                    ("submit", spec_bytes, fn_id, fn_bytes | None),
+                    ("refs", [(delta, oid_bin), ...])
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
+import queue
 import sys
+import threading
 
 from ..common.ids import ObjectID, TaskID
 from .object_ref import ObjectRef
 from .serialization import RayTaskError, deserialize, serialize
+
+# reply frame kinds the reader routes to the API reply queue
+_REPLY_KINDS = frozenset({"get_reply", "get_reply_x", "wait_reply",
+                          "kv_reply", "named_actor_reply"})
 
 
 class ArgRef:
@@ -80,29 +94,59 @@ class WorkerApiContext:
 
     Installed as the process-global runtime by ``worker_main``; the
     ``ray_tpu.api`` front end routes to it when running inside a worker.
-    """
+    Thread-safe: concurrent actor calls share it (sends are serialized;
+    request/reply API calls additionally hold ``_api_lock`` end-to-end,
+    which also keeps get-ack order matched to the raylet's pin FIFO).
+    The current task id is a context variable, so it is correct per
+    thread AND per asyncio task."""
 
     is_driver = False
 
     def __init__(self, conn, arena_path: str | None = None):
         self._conn = conn
-        self._task_id: TaskID | None = None
+        self._task_var: contextvars.ContextVar = \
+            contextvars.ContextVar("rt_task", default=None)
         self._put_index = 0
+        self._put_lock = threading.Lock()
         self._arena_path = arena_path
         self._arena = None          # lazily attached, read-only
+        self._arena_lock = threading.Lock()
         self.ref_counter = WorkerRefCounter()
-        # frames that arrived while this worker was waiting for a reply
-        # (pipelined actor calls land mid-get); the main loop drains them
-        # in order after the current task finishes
-        from collections import deque
-        self.pending_frames = deque()
+        self._send_lock = threading.Lock()
+        self._api_lock = threading.RLock()
+        self._flush_lock = threading.Lock()
+        self._reply_q: queue.SimpleQueue = queue.SimpleQueue()
+
+    # -- transport ----------------------------------------------------------
+    def send(self, msg) -> None:
+        with self._send_lock:
+            self._conn.send(msg)
+
+    def reader_loop(self, work_q: queue.SimpleQueue) -> None:
+        """Owns ``conn.recv``: replies to our API calls go to the reply
+        queue, work frames to the main loop's queue.  EOF poisons both."""
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] in _REPLY_KINDS:
+                self._reply_q.put(msg)
+            else:
+                work_q.put(msg)
+        work_q.put(None)
+        self._reply_q.put(None)
 
     def flush_refs(self) -> None:
-        """Ship queued local ref events to the raylet (called at frame
-        boundaries; FIFO on the pipe keeps per-holder event order)."""
-        events = self.ref_counter.drain()
-        if events:
-            self._conn.send(("refs", events))
+        """Ship queued local ref events to the raylet.  Drain and send
+        hold one lock so concurrent actor-call threads cannot split a
+        +/- pair across two frames that then hit the wire out of order
+        (per-holder event order is the counter's correctness
+        invariant)."""
+        with self._flush_lock:
+            events = self.ref_counter.drain()
+            if events:
+                self.send(("refs", events))
 
     def _materialize(self, desc, extern=None):
         """Resolve a descriptor: in-band value ("v"), in-band serialized
@@ -124,84 +168,103 @@ class WorkerApiContext:
                 "(the node agent failed to rewrite it)")
         # ("s", offset, size): attach the arena once, read zero-copy
         if self._arena is None:
-            from ..native import Arena
-            self._arena = Arena(self._arena_path)
+            with self._arena_lock:
+                if self._arena is None:
+                    from ..native import Arena
+                    self._arena = Arena(self._arena_path)
         return deserialize(self._arena.view(desc[1], desc[2]))
 
     def _recv_reply(self, expected_kinds):
         if isinstance(expected_kinds, str):
             expected_kinds = (expected_kinds,)
         while True:
-            msg = self._conn.recv()
+            msg = self._reply_q.get()
+            if msg is None:
+                raise ConnectionError("raylet connection lost")
             if msg[0] in expected_kinds:
                 return msg
-            self.pending_frames.append(msg)
+            # stale reply (an abandoned earlier call): drop it
 
-    # -- task lifecycle (called by the exec loop) ---------------------------
+    # -- task lifecycle (called by the exec paths) --------------------------
     def begin_task(self, task_id: TaskID):
-        self._task_id = task_id
-        self._put_index = 0
+        return self._task_var.set(task_id)
 
-    def end_task(self):
-        self._task_id = None
+    def end_task(self, token=None):
+        if token is not None:
+            self._task_var.reset(token)
+        else:
+            self._task_var.set(None)
 
     @property
     def current_task_id(self) -> TaskID | None:
-        return self._task_id
+        return self._task_var.get()
 
     # -- API ----------------------------------------------------------------
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
-        self._conn.send(("get", [r.binary() for r in refs], timeout))
-        msg = self._recv_reply(("get_reply", "get_reply_x"))
-        if msg[0] == "get_reply":
-            status, descs = deserialize(msg[1])
-        else:       # plane mode: descriptors ride outside the pickle
-            status, descs = msg[1], msg[2]
-        if status == "timeout":
-            from .object_store import GetTimeoutError
-            raise GetTimeoutError(
-                f"get timed out after {timeout}s inside worker")
-        try:
-            values = [self._materialize(d) for d in descs]
-        finally:
-            # ack releases the raylet/agent-side pins on this reply's
-            # shm descriptors; sent only when the reply carried any
-            if any(d[0] == "s" for d in descs):
-                self._conn.send(("get_ack",))
+        # the WHOLE request/reply/materialize/ack sequence holds the api
+        # lock: the raylet releases get-reply pins on acks in FIFO order
+        # per worker, so two threads' acks must not interleave
+        with self._api_lock:
+            self.send(("get", [r.binary() for r in refs], timeout))
+            msg = self._recv_reply(("get_reply", "get_reply_x"))
+            if msg[0] == "get_reply":
+                status, descs = deserialize(msg[1])
+            else:       # plane mode: descriptors ride outside the pickle
+                status, descs = msg[1], msg[2]
+            if status == "timeout":
+                from .object_store import GetTimeoutError
+                raise GetTimeoutError(
+                    f"get timed out after {timeout}s inside worker")
+            try:
+                values = [self._materialize(d) for d in descs]
+            finally:
+                # ack releases the raylet/agent-side pins on this
+                # reply's shm descriptors; sent only when any exist
+                if any(d[0] == "s" for d in descs):
+                    self.send(("get_ack",))
         for v in values:
             if isinstance(v, RayTaskError):
                 raise v.cause if v.cause is not None else v
         return values
 
     def put(self, value) -> ObjectRef:
-        assert self._task_id is not None, "put outside a task"
-        self._put_index += 1
-        oid = ObjectID.for_put(self._task_id, self._put_index)
+        task_id = self.current_task_id
+        assert task_id is not None, "put outside a task"
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        # the process-wide monotonic index keeps put ids unique across
+        # concurrent calls (per-task indexes could collide after an
+        # interleaving); ids still embed the creating task
+        oid = ObjectID.for_put(task_id, idx)
         from .object_ref import serialize_collecting
         data, contained = serialize_collecting(value)
         self.flush_refs()
-        self._conn.send(("put", oid.binary(), data, contained))
+        self.send(("put", oid.binary(), data, contained))
         return ObjectRef(oid)
 
     def wait(self, refs, num_returns, timeout):
         """True ray.wait semantics: the raylet-side store partitions by
         actual readiness; partial (ready, not_ready) on timeout, no raise."""
-        self._conn.send(("wait", [r.binary() for r in refs], num_returns,
-                         timeout))
-        _, payload = self._recv_reply("wait_reply")
+        with self._api_lock:
+            self.send(("wait", [r.binary() for r in refs], num_returns,
+                       timeout))
+            _, payload = self._recv_reply("wait_reply")
         ready_bins = set(deserialize(payload))
         ready = [r for r in refs if r.binary() in ready_bins]
         not_ready = [r for r in refs if r.binary() not in ready_bins]
         return ready, not_ready
 
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
-        self._conn.send(("submit", serialize(spec), fn_id, fn_bytes))
+        self.flush_refs()
+        self.send(("submit", serialize(spec), fn_id, fn_bytes))
 
     def kv_op(self, op: str, key: bytes, value: bytes | None = None,
               namespace: str = "", overwrite: bool = True):
         """GCS KV access from inside a task (internal_kv parity)."""
-        self._conn.send(("kv", op, key, value, namespace, overwrite))
-        reply = self._recv_reply("kv_reply")
+        with self._api_lock:
+            self.send(("kv", op, key, value, namespace, overwrite))
+            reply = self._recv_reply("kv_reply")
         if reply[2] is not None:
             raise RuntimeError(f"internal_kv {op} failed: {reply[2]}")
         return reply[1]
@@ -210,35 +273,218 @@ class WorkerApiContext:
     def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
                      args, kwargs, max_restarts: int, max_task_retries: int,
                      name: str | None, resources=None, strategy=None,
-                     runtime_env=None):
-        self._conn.send(("actor_create", actor_id.binary(), cls_id,
-                         cls_bytes, serialize(
-                             (args, kwargs, max_restarts, max_task_retries,
-                              name, resources, strategy, runtime_env))))
+                     runtime_env=None, concurrency: dict | None = None):
+        self.flush_refs()
+        self.send(("actor_create", actor_id.binary(), cls_id,
+                   cls_bytes, serialize(
+                       (args, kwargs, max_restarts, max_task_retries,
+                        name, resources, strategy, runtime_env,
+                        concurrency))))
 
     # -- placement groups (frames handled by the raylet) --------------------
     def create_placement_group(self, pg_id, bundles, strategy_name: str,
                                name: str | None):
-        self._conn.send(("pg_create", pg_id.binary(),
-                         serialize((bundles, strategy_name, name))))
+        self.send(("pg_create", pg_id.binary(),
+                   serialize((bundles, strategy_name, name))))
 
     def remove_placement_group(self, pg_id):
-        self._conn.send(("pg_remove", pg_id.binary()))
+        self.send(("pg_remove", pg_id.binary()))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
                           kwargs, num_returns: int,
-                          trace_ctx: tuple | None = None):
-        self._conn.send(("actor_submit", actor_id.binary(),
-                         task_id.binary(), method,
-                         serialize((args, kwargs, num_returns,
-                                    trace_ctx))))
+                          trace_ctx: tuple | None = None,
+                          concurrency_group: str | None = None):
+        self.flush_refs()
+        self.send(("actor_submit", actor_id.binary(),
+                   task_id.binary(), method,
+                   serialize((args, kwargs, num_returns, trace_ctx,
+                              concurrency_group))))
 
     def kill_actor(self, actor_id, no_restart: bool = True):
-        self._conn.send(("actor_kill", actor_id.binary(), no_restart))
+        self.send(("actor_kill", actor_id.binary(), no_restart))
 
     def get_actor_id_by_name(self, name: str):
-        self._conn.send(("named_actor", name))
-        return self._recv_reply("named_actor_reply")[1]
+        with self._api_lock:
+            self.send(("named_actor", name))
+            return self._recv_reply("named_actor_reply")[1]
+
+
+class _ActorExecutor:
+    """Runs one actor's method calls under its concurrency model.
+
+    Reference parity: async actors run coroutine methods on a dedicated
+    event loop (default ``max_concurrency`` 1000); threaded actors run
+    up to ``max_concurrency`` calls on a pool; ``concurrency_groups``
+    bound named groups independently, with the unnamed remainder on the
+    default group (core worker's ``ConcurrencyGroupManager`` /
+    ``FiberStateManager`` — SURVEY.md §1 layer 7; mount empty).
+    ``max_concurrency == 1`` executes inline on the main loop thread,
+    preserving the strict FIFO the reference gives plain actors."""
+
+    def __init__(self, ctx: WorkerApiContext, instance,
+                 concurrency: dict | None):
+        import inspect
+        self._ctx = ctx
+        self.instance = instance
+        conc = concurrency or {}
+        self._is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _n, m in inspect.getmembers(type(instance))
+            if callable(m))
+        default = 1000 if self._is_async else 1
+        self.max_concurrency = int(conc.get("max_concurrency") or default)
+        self._groups: dict[str, object] = {}
+        self._loop = None
+        self._loop_thread = None
+        self._sem = None
+        group_sizes = dict(conc.get("concurrency_groups") or {})
+        if self._is_async:
+            import asyncio
+            self._loop = asyncio.new_event_loop()
+            self._loop_thread = threading.Thread(
+                target=self._loop.run_forever, daemon=True,
+                name="actor-async-loop")
+            self._loop_thread.start()
+            self._sem = {
+                None: asyncio.Semaphore(self.max_concurrency)}
+            for gname, n in group_sizes.items():
+                self._sem[gname] = asyncio.Semaphore(int(n))
+        elif self.max_concurrency > 1 or group_sizes:
+            from concurrent.futures import ThreadPoolExecutor
+            self._groups[None] = ThreadPoolExecutor(
+                max_workers=self.max_concurrency,
+                thread_name_prefix="actor-call")
+            for gname, n in group_sizes.items():
+                self._groups[gname] = ThreadPoolExecutor(
+                    max_workers=int(n),
+                    thread_name_prefix=f"actor-{gname}")
+
+    @property
+    def inline(self) -> bool:
+        return self._loop is None and not self._groups
+
+    def dispatch(self, run, group: str | None) -> None:
+        """Run ``run()`` (a fully-bound call closure) under the model."""
+        if self._loop is not None:
+            import asyncio
+            sem = self._sem.get(group) or self._sem[None]
+
+            async def guarded():
+                async with sem:
+                    await run()
+            asyncio.run_coroutine_threadsafe(guarded(), self._loop)
+            return
+        pool = self._groups.get(group) or self._groups.get(None)
+        if pool is None:
+            run()
+        else:
+            pool.submit(run)
+
+    def shutdown(self) -> None:
+        for pool in self._groups.values():
+            pool.shutdown(wait=True)
+        if self._loop is not None:
+            # drain ON the loop: run_coroutine_threadsafe callbacks are
+            # FIFO, so every previously dispatched call has created its
+            # task by the time drain() runs — counting from this thread
+            # instead would race task creation (and iterate the task
+            # WeakSet unsafely from outside the loop)
+            import asyncio
+
+            async def drain():
+                while True:
+                    others = [t for t in asyncio.all_tasks()
+                              if t is not asyncio.current_task()]
+                    if not others:
+                        return
+                    await asyncio.gather(*others,
+                                         return_exceptions=True)
+            fut = asyncio.run_coroutine_threadsafe(drain(), self._loop)
+            try:
+                fut.result(timeout=10.0)
+            except Exception:   # noqa: BLE001 — wedge: stop anyway
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(timeout=5.0)
+
+
+class _CallScope:
+    """Shared per-call scaffolding: task context + trace span on entry;
+    span exit, task reset, error frame, and ref flush on the way out."""
+
+    def __init__(self, ctx: WorkerApiContext, task_id_bin: bytes,
+                 method: str, trace_ctx):
+        self._ctx = ctx
+        self._tid = task_id_bin
+        self._method = method
+        self._trace = trace_ctx
+        self._scope = None
+        self._token = None
+
+    def __enter__(self):
+        self._token = self._ctx.begin_task(TaskID(self._tid))
+        if self._trace is not None:
+            from ..util.tracing import span_scope
+            self._scope = span_scope(self._trace[0],
+                                     TaskID(self._tid).hex())
+            self._scope.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, _tb):
+        if exc is not None:
+            self._ctx.send(("actor_error", self._tid, serialize(
+                RayTaskError.from_exception(self._method, exc))))
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+        self._ctx.end_task(self._token)
+        try:
+            self._ctx.flush_refs()
+        except (OSError, BrokenPipeError):
+            pass
+        return True         # error already shipped as a frame
+
+
+def _run_actor_call(ctx: WorkerApiContext, executor: _ActorExecutor,
+                    task_id_bin: bytes, method: str, args, kwargs,
+                    num_returns: int, trace_ctx) -> None:
+    """Execute one actor method call and ship its result — runs inline
+    or on a pool thread."""
+    with _CallScope(ctx, task_id_bin, method, trace_ctx):
+        out = getattr(executor.instance, method)(*args, **kwargs)
+        if hasattr(out, "__await__"):
+            raise RuntimeError("coroutine escaped the async path")
+        _send_call_results(ctx, task_id_bin, method, out, num_returns)
+
+
+async def _run_actor_call_async(ctx, executor, task_id_bin, method,
+                                args, kwargs, num_returns,
+                                trace_ctx) -> None:
+    with _CallScope(ctx, task_id_bin, method, trace_ctx):
+        out = getattr(executor.instance, method)(*args, **kwargs)
+        if hasattr(out, "__await__"):
+            out = await out
+        _send_call_results(ctx, task_id_bin, method, out, num_returns)
+
+
+def _send_call_results(ctx, task_id_bin, method, out,
+                       num_returns: int) -> None:
+    from .object_ref import serialize_collecting
+    if num_returns == 1:
+        results = [out]
+    elif num_returns == 0:
+        results = []
+    else:
+        results = list(out)
+        if len(results) != num_returns:
+            raise ValueError(
+                f"actor method {method} declared num_returns="
+                f"{num_returns} but returned {len(results)} values")
+    payloads, contained = [], []
+    for r in results:
+        data, inner = serialize_collecting(r)
+        payloads.append(data)
+        contained.append(inner)
+    ctx.send(("actor_result", task_id_bin, payloads, contained))
 
 
 def worker_main(conn, worker_index: int,
@@ -262,18 +508,17 @@ def worker_main(conn, worker_index: int,
     from .object_ref import install_counter, serialize_collecting
     install_counter(ctx.ref_counter)
     fn_table: dict[str, object] = {}
-    actor_instance = None            # dedicated worker: one actor
+    executor: _ActorExecutor | None = None   # dedicated worker: one actor
     actor_id_bin = None
-    conn.send(("ready",))
+    work_q: queue.SimpleQueue = queue.SimpleQueue()
+    threading.Thread(target=ctx.reader_loop, args=(work_q,),
+                     daemon=True, name="rt-worker-reader").start()
+    ctx.send(("ready",))
 
     while True:
-        if ctx.pending_frames:
-            msg = ctx.pending_frames.popleft()
-        else:
-            try:
-                msg = conn.recv()
-            except (EOFError, OSError):
-                break
+        msg = work_q.get()
+        if msg is None:
+            break
         kind = msg[0]
         if kind == "fn":
             fn_table[msg[1]] = deserialize(msg[2])
@@ -288,7 +533,7 @@ def worker_main(conn, worker_index: int,
                          if isinstance(a, ArgRef) else a for a in args)
             fn = fn_table[fn_id]
             name = getattr(fn, "__qualname__", str(fn))
-            ctx.begin_task(TaskID(task_id_bin))
+            token = ctx.begin_task(TaskID(task_id_bin))
             if trace_ctx is not None:
                 # this task's span becomes the ambient scope, so specs
                 # it submits inherit (trace_id, THIS span) as context
@@ -315,18 +560,18 @@ def worker_main(conn, worker_index: int,
                     data, inner = serialize_collecting(r)
                     payloads.append(data)
                     contained.append(inner)
-                conn.send(("result", task_id_bin, payloads, contained))
+                ctx.send(("result", task_id_bin, payloads, contained))
             except BaseException as e:  # noqa: BLE001 — any task failure
                 err = RayTaskError.from_exception(name, e)
                 try:
-                    conn.send(("error", task_id_bin, serialize(err)))
+                    ctx.send(("error", task_id_bin, serialize(err)))
                 except Exception:
-                    conn.send(("error", task_id_bin, serialize(
+                    ctx.send(("error", task_id_bin, serialize(
                         RayTaskError(name, err.tb, None))))
             finally:
                 if _scope is not None:
                     _scope.__exit__(None, None, None)
-                ctx.end_task()
+                ctx.end_task(token)
                 # task locals must die NOW, not when the next exec
                 # overwrites these loop variables — their ObjectRefs'
                 # decrefs ride the flush below ("r" is the serialization
@@ -334,66 +579,65 @@ def worker_main(conn, worker_index: int,
                 args = kwargs = out = results = payloads = r = None
         elif kind == "actor_new":
             _, actor_id_bin, cls_id, payload = msg
-            args, kwargs = deserialize(payload)
+            unpacked = deserialize(payload)
+            if len(unpacked) == 3:
+                args, kwargs, concurrency = unpacked
+            else:           # pre-concurrency frame shape
+                args, kwargs = unpacked
+                concurrency = None
             cls = fn_table[cls_id]
-            ctx.begin_task(TaskID.deterministic(actor_id_bin,
-                                                _nil_actor()))
+            token = ctx.begin_task(TaskID.deterministic(actor_id_bin,
+                                                        _nil_actor()))
             try:
-                actor_instance = cls(*args, **kwargs)
-                conn.send(("actor_ready", actor_id_bin))
+                instance = cls(*args, **kwargs)
+                executor = _ActorExecutor(ctx, instance, concurrency)
+                ctx.send(("actor_ready", actor_id_bin))
             except BaseException as e:  # noqa: BLE001
-                conn.send(("actor_init_error", actor_id_bin, serialize(
+                ctx.send(("actor_init_error", actor_id_bin, serialize(
                     RayTaskError.from_exception(
                         getattr(cls, "__name__", "actor") + ".__init__",
                         e))))
             finally:
-                ctx.end_task()
+                ctx.end_task(token)
+                args = kwargs = None
         elif kind == "actor_call":
             _, task_id_bin, method, payload = msg
-            args, kwargs, num_returns, trace_ctx = deserialize(payload)
+            unpacked = deserialize(payload)
+            if len(unpacked) == 5:
+                args, kwargs, num_returns, trace_ctx, group = unpacked
+            else:           # pre-concurrency frame shape
+                args, kwargs, num_returns, trace_ctx = unpacked
+                group = None
             if method == "__ray_terminate__":
-                conn.send(("actor_exit", actor_id_bin))
-                conn.send(("actor_result", task_id_bin, [serialize(None)]))
+                # graceful stop: let in-flight concurrent calls finish
+                if executor is not None:
+                    executor.shutdown()
+                ctx.send(("actor_exit", actor_id_bin))
+                ctx.send(("actor_result", task_id_bin,
+                          [serialize(None)], [[]]))
                 break
-            ctx.begin_task(TaskID(task_id_bin))
-            if trace_ctx is not None:
-                # tasks the actor method submits link under this call
-                from ..util.tracing import span_scope
-                _scope = span_scope(trace_ctx[0], TaskID(task_id_bin).hex())
-                _scope.__enter__()
+            if executor is None:
+                ctx.send(("actor_error", task_id_bin, serialize(
+                    RayTaskError(method, "actor instance missing"))))
+                args = kwargs = None
+                continue
+            if executor._loop is not None:
+                coro_args = (ctx, executor, task_id_bin, method, args,
+                             kwargs, num_returns, trace_ctx)
+                executor.dispatch(
+                    lambda a=coro_args: _run_actor_call_async(*a), group)
+            elif executor.inline:
+                _run_actor_call(ctx, executor, task_id_bin, method,
+                                args, kwargs, num_returns, trace_ctx)
             else:
-                _scope = None
-            try:
-                bound = getattr(actor_instance, method)
-                out = bound(*args, **kwargs)
-                if num_returns == 1:
-                    results = [out]
-                elif num_returns == 0:
-                    results = []
-                else:
-                    results = list(out)
-                    if len(results) != num_returns:
-                        raise ValueError(
-                            f"actor method {method} declared num_returns="
-                            f"{num_returns} but returned {len(results)} "
-                            "values")
-                payloads, contained = [], []
-                for r in results:
-                    data, inner = serialize_collecting(r)
-                    payloads.append(data)
-                    contained.append(inner)
-                conn.send(("actor_result", task_id_bin, payloads,
-                           contained))
-            except BaseException as e:  # noqa: BLE001
-                conn.send(("actor_error", task_id_bin, serialize(
-                    RayTaskError.from_exception(method, e))))
-            finally:
-                if _scope is not None:
-                    _scope.__exit__(None, None, None)
-                ctx.end_task()
-                # call locals die now (see the exec branch)
-                args = kwargs = out = results = payloads = r = None
+                call_args = (ctx, executor, task_id_bin, method, args,
+                             kwargs, num_returns, trace_ctx)
+                executor.dispatch(
+                    lambda a=call_args: _run_actor_call(*a), group)
+            args = kwargs = None
         elif kind == "shutdown":
+            if executor is not None:
+                executor.shutdown()
             break
         # ship ref events born while handling this frame (task locals
         # died, results built refs) — per-holder order rides the pipe

@@ -2,8 +2,9 @@
 
 Reference parity: Serve's controller owns per-deployment replica sets
 and reconciles them against target counts; ``DeploymentHandle`` routes
-requests client-side (power-of-two-choices in upstream; round-robin
-here) and reports load; autoscaling moves replica counts between
+requests client-side with power-of-two-choices on observed in-flight
+load (as upstream) and reports load; autoscaling moves replica counts
+between
 ``min_replicas`` and ``max_replicas`` to hold
 ``target_ongoing_requests`` per replica (``python/ray/serve/`` —
 SURVEY.md §1 layer 14; mount empty).
@@ -29,9 +30,11 @@ class _ReplicaShell:
 
     The GCS KV inflight counter is incremented by the HANDLE at submit
     (so queued requests count toward autoscaling) and decremented HERE
-    when execution completes — both on single-threaded worker paths, so
-    no extra threads touch the worker's pipe (a concurrent reader would
-    steal reply frames and deadlock composed deployments).
+    when execution completes.  Replicas run as threaded actors
+    (``max_concurrency`` = the deployment's ``max_ongoing_requests``),
+    so a slow request does not head-of-line-block the others — the
+    worker's reader-thread frame routing makes the shared pipe safe
+    for concurrent calls.
     """
 
     def __init__(self, target_bytes: bytes, init_args: bytes,
@@ -62,12 +65,13 @@ class _Controller:
 
     def __init__(self, cls_or_fn_bytes: bytes, init_args: bytes,
                  num_replicas: int, autoscaling: dict | None,
-                 actor_options: dict):
+                 actor_options: dict, max_ongoing_requests: int = 4):
         import os
         self._target_bytes = cls_or_fn_bytes
         self._init_args_bytes = init_args
         self._autoscaling = autoscaling
         self._actor_options = dict(actor_options)
+        self._max_ongoing = max(int(max_ongoing_requests), 1)
         self._kv_key = f"inflight-{os.urandom(6).hex()}"
         self._replicas: list = []
         self._version = 0
@@ -83,6 +87,10 @@ class _Controller:
         import ray_tpu
         actor_cls = ray_tpu.remote(_ReplicaShell)
         opts = dict(self._actor_options)
+        # replicas handle requests CONCURRENTLY (threaded actor up to
+        # max_ongoing_requests — upstream replicas do the same on their
+        # event loop)
+        opts.setdefault("max_concurrency", self._max_ongoing)
         stub = actor_cls.options(**opts) if opts else actor_cls
         handle = stub.remote(self._target_bytes, self._init_args_bytes,
                              self._kv_key)
@@ -152,13 +160,15 @@ class _Controller:
 # -- handle ------------------------------------------------------------------
 
 class DeploymentHandle:
-    """Routes ``.remote`` calls across the deployment's replicas.
+    """Routes ``.remote`` calls across the deployment's replicas with
+    power-of-two-choices on locally-observed outstanding requests
+    (upstream's router picks the less-loaded of two random replicas
+    from its cached load view; here the handle's own in-flight counts
+    are that view, settled by seal callbacks in the driver).
 
     Serializable (carries only the controller's actor handle), so
     deployments compose: pass one deployment's handle to another's
-    ``bind``.  Everything runs on the CALLER's thread — no background
-    waiters, because a second thread on a worker's pipe steals reply
-    frames and deadlocks the replica (load settles in _ReplicaShell).
+    ``bind``.
     """
 
     def __init__(self, controller_handle, method: str = "__call__"):
@@ -169,6 +179,9 @@ class DeploymentHandle:
         self._replicas: list = []
         self._kv_key: bytes = b""
         self._rr = 0
+        # locally-observed outstanding calls per replica index — the
+        # router's load view (reset on refresh: replica set changed)
+        self._outstanding: dict[bytes, int] = {}
 
     def options(self, *, method_name: str) -> "DeploymentHandle":
         return DeploymentHandle(self._controller, method_name)
@@ -176,8 +189,51 @@ class DeploymentHandle:
     def _refresh(self) -> None:
         version, replicas, kv_key = _api().get(
             self._controller.get_replicas.remote(), timeout=30)
+        if version != self._version:
+            self._outstanding.clear()
         self._version, self._replicas = version, replicas
         self._kv_key = kv_key.encode()
+
+    def _pick_replica(self):
+        """Power of two choices on the local outstanding view; ties and
+        the single-replica case fall back to round robin."""
+        import random
+        n = len(self._replicas)
+        if n == 1:
+            self._rr += 1
+            return self._replicas[0]
+        i, j = random.sample(range(n), 2)
+        li = self._outstanding.get(
+            self._replicas[i]._actor_id.binary(), 0)
+        lj = self._outstanding.get(
+            self._replicas[j]._actor_id.binary(), 0)
+        if li == lj:
+            pick = (i, j)[self._rr % 2]
+        else:
+            pick = i if li < lj else j
+        self._rr += 1
+        return self._replicas[pick]
+
+    def _settle(self, replica_key: bytes, ref) -> None:
+        """Decrement the local load view when the reply seals.  Only a
+        driver-side handle can observe completion (store seal
+        callbacks); client/worker handles decrement IMMEDIATELY — their
+        view degenerates to round-robin rather than accumulating
+        lifetime totals that would invert the load signal."""
+        def done(_oid=None):
+            with self._lock:
+                c = self._outstanding.get(replica_key, 0)
+                if c > 0:
+                    self._outstanding[replica_key] = c - 1
+        try:
+            from ray_tpu.api import _get_runtime
+            store = getattr(_get_runtime(), "store", None)
+        except Exception:   # noqa: BLE001
+            store = None
+        if store is None:
+            done()
+            return
+        store.on_ready(ref.id, done)
 
     def remote(self, *args, **kwargs):
         from ray_tpu.actor_api import ActorMethod
@@ -190,15 +246,18 @@ class DeploymentHandle:
                 _api().get(self._controller.ensure_replica.remote(),
                            timeout=60)
                 self._refresh()
-            replica = self._replicas[self._rr % len(self._replicas)]
-            self._rr += 1
+            replica = self._pick_replica()
+            rkey = replica._actor_id.binary()
+            self._outstanding[rkey] = self._outstanding.get(rkey, 0) + 1
         # queued-request accounting: +1 BEFORE submit so backlog (not
         # just executing calls) drives upscaling; the replica shell
         # decrements on completion
         _internal_kv_incr(self._kv_key, 1, namespace="serve")
         self._controller.tick.remote()      # fire-and-forget scale poke
-        return ActorMethod(replica, "__serve_call__").remote(
+        ref = ActorMethod(replica, "__serve_call__").remote(
             self._method, args, kwargs)
+        self._settle(rkey, ref)
+        return ref
 
     def __reduce__(self):
         return (DeploymentHandle, (self._controller, self._method))
@@ -217,17 +276,20 @@ class Deployment:
     def __init__(self, target: type | Callable, name: str,
                  num_replicas: int = 1,
                  autoscaling_config: dict | None = None,
-                 ray_actor_options: dict | None = None):
+                 ray_actor_options: dict | None = None,
+                 max_ongoing_requests: int = 4):
         self._target = target
         self.name = name
         self._num_replicas = num_replicas
         self._autoscaling = autoscaling_config
         self._actor_options = dict(ray_actor_options or {})
+        self._max_ongoing = max_ongoing_requests
 
     def options(self, *, num_replicas: int | None = None,
                 autoscaling_config: dict | None = None,
                 ray_actor_options: dict | None = None,
-                name: str | None = None) -> "Deployment":
+                name: str | None = None,
+                max_ongoing_requests: int | None = None) -> "Deployment":
         return Deployment(
             self._target, name or self.name,
             num_replicas if num_replicas is not None
@@ -235,7 +297,9 @@ class Deployment:
             autoscaling_config if autoscaling_config is not None
             else self._autoscaling,
             ray_actor_options if ray_actor_options is not None
-            else self._actor_options)
+            else self._actor_options,
+            max_ongoing_requests if max_ongoing_requests is not None
+            else self._max_ongoing)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -244,12 +308,14 @@ class Deployment:
 def deployment(target: type | Callable | None = None, *,
                name: str | None = None, num_replicas: int = 1,
                autoscaling_config: dict | None = None,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               max_ongoing_requests: int = 4):
     """``@serve.deployment`` (bare or parameterized)."""
     def make(t):
         tgt = t if isinstance(t, type) else _wrap_function(t)
         return Deployment(tgt, name or t.__name__, num_replicas,
-                          autoscaling_config, ray_actor_options)
+                          autoscaling_config, ray_actor_options,
+                          max_ongoing_requests)
     if target is not None:
         return make(target)
     return make
@@ -324,7 +390,8 @@ def run(app: Application, *, name: str = "default",
     controller_cls = ray_tpu.remote(_Controller)
     controller = controller_cls.remote(
         serialize(dep._target), serialize((app.args, app.kwargs)),
-        dep._num_replicas, dep._autoscaling, dep._actor_options)
+        dep._num_replicas, dep._autoscaling, dep._actor_options,
+        dep._max_ongoing)
     # materialize the replica set before returning the handle
     ray_tpu.get(controller.num_replicas.remote(), timeout=60)
     handle = DeploymentHandle(controller)

@@ -119,18 +119,20 @@ class ClientRuntime:
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
                      resources=None, strategy=None,
-                     runtime_env=None) -> None:
+                     runtime_env=None, concurrency=None) -> None:
         self._call("create_actor", actor_id.binary(), cls_id, cls_bytes,
                    serialize((args, kwargs, max_restarts,
                               max_task_retries, name, resources,
-                              strategy, runtime_env)))
+                              strategy, runtime_env, concurrency)))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
                           kwargs, num_returns: int,
-                          trace_ctx: tuple | None = None) -> None:
+                          trace_ctx: tuple | None = None,
+                          concurrency_group: str | None = None) -> None:
         self._call("submit_actor_call", actor_id.binary(),
                    task_id.binary(), method,
-                   serialize((args, kwargs, trace_ctx)), num_returns)
+                   serialize((args, kwargs, trace_ctx,
+                              concurrency_group)), num_returns)
 
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         self._call("kill_actor", actor_id.binary(), no_restart)

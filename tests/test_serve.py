@@ -159,6 +159,50 @@ class TestAutoscaling:
         assert serve.status()["num_replicas"] <= 2
 
 
+class TestConcurrentReplicas:
+    def test_replica_handles_concurrent_requests(self):
+        """One replica with max_ongoing_requests=4 overlaps slow calls
+        (upstream replicas serve concurrently on their event loop)."""
+        @serve.deployment(num_replicas=1, max_ongoing_requests=4)
+        class Slow:
+            def __call__(self, dt):
+                time.sleep(dt)
+                return "ok"
+
+        handle = serve.run(Slow.bind())
+        t0 = time.monotonic()
+        out = ray_tpu.get([handle.remote(0.8) for _ in range(4)],
+                          timeout=60)
+        elapsed = time.monotonic() - t0
+        assert out == ["ok"] * 4
+        assert elapsed < 2.6, elapsed   # serial would be >= 3.2
+
+    def test_router_prefers_less_loaded_replica(self):
+        """Power-of-two-choices: with one replica wedged by slow calls,
+        new requests drain through the other."""
+        @serve.deployment(num_replicas=2, max_ongoing_requests=2)
+        class Which:
+            def __init__(self):
+                import os
+                self.pid = os.getpid()
+
+            def __call__(self, dt):
+                time.sleep(dt)
+                return self.pid
+
+        handle = serve.run(Which.bind())
+        # wedge whichever replica gets the first slow burst
+        slow = [handle.remote(3.0) for _ in range(2)]
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        quick = ray_tpu.get([handle.remote(0.01) for _ in range(6)],
+                            timeout=60)
+        dt = time.monotonic() - t0
+        # the quick batch must not have waited behind the 3s calls
+        assert dt < 2.5, dt
+        ray_tpu.get(slow, timeout=60)
+
+
 class TestHttpIngress:
     @pytest.fixture(autouse=True)
     def http_cleanup(self):
