@@ -118,6 +118,18 @@ class DriverRuntime:
             self.store.put(oid, value)
         return oid
 
+    # -- streaming generators ------------------------------------------------
+    def stream_wait(self, task_id, index: int,
+                    timeout: float | None = None):
+        return self.cluster.task_manager.wait_stream(task_id, index,
+                                                     timeout)
+
+    def stream_ack(self, task_id, consumed: int) -> None:
+        self.cluster.stream_ack(task_id, consumed)
+
+    def stream_close(self, task_id, consumed: int) -> None:
+        self.cluster.stream_close(task_id, consumed)
+
     def wait(self, refs, num_returns, timeout):
         ready_ids, not_ready_ids = self.wait_raw(
             [r.id for r in refs], num_returns, timeout)
@@ -272,22 +284,30 @@ class RemoteFunction:
                                 self._strategy.placement_group_id.hex(),
                                 self._strategy.bundle_index)
         from .util.tracing import context_for_new_task
+        # "streaming" rides the wire as -1: the task is a GENERATOR and
+        # its items seal incrementally (reference num_returns="streaming")
+        num_returns = -1 if self._num_returns == "streaming" \
+            else self._num_returns
         spec = TaskSpec(
             task_id=task_id, job_id=job_id, task_type=TaskType.NORMAL_TASK,
             function_descriptor=fn_id, args=args, kwargs=kwargs,
-            num_returns=self._num_returns,
+            num_returns=num_returns,
             resources=ResourceRequest(res),
             strategy=self._strategy, max_retries=retries,
             runtime_env=self._runtime_env,  # the job-level env merges in
             #                                 at the raylet submit intake
             trace_ctx=context_for_new_task(task_id))
+        if num_returns == -1:
+            from .runtime.object_ref import ObjectRefGenerator
+            rt.submit_spec(spec, fn_id, fn_bytes)
+            return ObjectRefGenerator(task_id, rt)
         # result refs are created BEFORE submission: the owner's refcount
         # must never dip to zero while the caller is still building them
         from .common.ids import ObjectID
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
-                for i in range(self._num_returns)]
+                for i in range(num_returns)]
         rt.submit_spec(spec, fn_id, fn_bytes)
-        return refs[0] if self._num_returns == 1 else refs
+        return refs[0] if num_returns == 1 else refs
 
 
 def remote(*args, **options):

@@ -331,6 +331,29 @@ class Cluster:
         with self._lock:
             return self.raylets.get(row)
 
+    def stream_ack(self, task_id, consumed: int) -> None:
+        """Route a streaming-generator consumption ack to whichever
+        raylet is running the task (best-effort)."""
+        with self._lock:
+            raylets = list(self.raylets.values())
+        for r in raylets:
+            if r.stream_ack(task_id, consumed):
+                return
+
+    def stream_close(self, task_id, consumed: int) -> None:
+        """Consumer finished/abandoned a stream: cancel the producer
+        cooperatively (it stops yielding at its next backpressure
+        check) and reclaim sealed-but-unconsumed items everywhere."""
+        orphans = self.task_manager.stream_close(task_id, consumed)
+        with self._lock:
+            raylets = list(self.raylets.values())
+        for r in raylets:
+            if r.stream_cancel(task_id):
+                break
+        for oid in orphans:
+            if self.store.contains(oid):
+                self._reclaim_object(oid)
+
     # -- routing (spillback) ------------------------------------------------
     def route_local(self, row: int, task_id) -> bool:
         """Deliver a PLACED task into the target node's local dispatch

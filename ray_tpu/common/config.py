@@ -108,6 +108,11 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 4,
         "Concurrent transfer executors in the pull manager; activation "
         "stays quota-bounded (pull_manager_max_inflight_mb)."),
+    "streaming_backpressure_items": (
+        int, 16,
+        "Streaming-generator window: a generator task pauses once this "
+        "many yielded items are sealed but not yet consumer-acked "
+        "(reference _generator_backpressure_num_objects)."),
     "locality_aware_scheduling": (
         bool, True,
         "Prefer placing default-strategy tasks on the node holding the "

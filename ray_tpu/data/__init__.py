@@ -18,6 +18,9 @@ batches, not Python-loop rows), and ``split`` hands aligned shards to
 
 from .aggregate import GroupedDataset, read_csv, read_text
 from .dataset import Dataset, from_items, from_numpy, range  # noqa: A004
+from .streaming import (DataStream, stream_blocks, stream_from_items,
+                        stream_range)
 
-__all__ = ["Dataset", "GroupedDataset", "from_items", "from_numpy",
-           "range", "read_csv", "read_text"]
+__all__ = ["DataStream", "Dataset", "GroupedDataset", "from_items",
+           "from_numpy", "range", "read_csv", "read_text",
+           "stream_blocks", "stream_from_items", "stream_range"]

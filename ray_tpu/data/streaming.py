@@ -1,0 +1,137 @@
+"""Streaming dataset execution: bounded-memory pipelines over generators.
+
+Reference parity: upstream Data's streaming executor runs map stages
+over blocks with bounded in-flight resources instead of materializing
+every block (``python/ray/data/_internal/execution/`` — SURVEY.md §1
+layer 14; mount empty).  The rebuild's shape: the SOURCE is a streaming
+generator task (``num_returns="streaming"`` — the block producer pauses
+on consumer backpressure), map stages are per-block tasks submitted
+with a bounded window, and consumed block refs drop immediately so
+reference counting reclaims them.  Peak store occupancy is
+O(window + backpressure), not O(total blocks) — the property
+``tests/test_streaming.py`` pins.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator
+
+
+def _api():
+    import ray_tpu
+    return ray_tpu
+
+
+class DataStream:
+    """A lazy, bounded-memory block pipeline.
+
+    Build with :func:`stream_range` / :func:`stream_from_items` /
+    :func:`stream_blocks`, chain ``.map``/``.map_batches``/``.filter``,
+    then drain with ``iter_blocks()`` / ``iter_rows()`` / ``take_all()``.
+    Nothing executes until iteration starts."""
+
+    def __init__(self, source_fn: Callable[[], Iterable[list]],
+                 stages: tuple = (), window: int = 4):
+        self._source_fn = source_fn
+        self._stages = stages
+        self._window = max(int(window), 1)
+
+    # -- transforms (lazy) ---------------------------------------------------
+    def map(self, fn: Callable[[Any], Any]) -> "DataStream":
+        return DataStream(self._source_fn,
+                          self._stages + (("map", fn),), self._window)
+
+    def map_batches(self, fn: Callable[[list], list]) -> "DataStream":
+        return DataStream(self._source_fn,
+                          self._stages + (("map_batches", fn),),
+                          self._window)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "DataStream":
+        return DataStream(self._source_fn,
+                          self._stages + (("filter", fn),), self._window)
+
+    def window(self, n: int) -> "DataStream":
+        """Bound the number of blocks in flight through the map stages."""
+        return DataStream(self._source_fn, self._stages, n)
+
+    # -- execution -----------------------------------------------------------
+    def iter_blocks(self) -> Iterator[list]:
+        """Drive the pipeline: blocks stream from the generator source,
+        at most ``window`` are in the map stages at once, and each
+        yielded block's refs drop before the next is requested."""
+        ray = _api()
+        stages = self._stages
+
+        @ray.remote(num_returns="streaming")
+        def _source(src):
+            yield from src()
+
+        @ray.remote
+        def _apply(block, staged=stages):
+            for kind, fn in staged:
+                if kind == "map":
+                    block = [fn(r) for r in block]
+                elif kind == "map_batches":
+                    block = list(fn(block))
+                else:
+                    block = [r for r in block if fn(r)]
+            return block
+
+        gen = _source.remote(self._source_fn)
+        inflight: deque = deque()       # refs moving through the stages
+        src_done = False
+        while inflight or not src_done:
+            while not src_done and len(inflight) < self._window:
+                try:
+                    block_ref = next(gen)
+                except StopIteration:
+                    src_done = True
+                    break
+                if stages:
+                    inflight.append(_apply.remote(block_ref))
+                    del block_ref       # the stage task owns it now
+                else:
+                    inflight.append(block_ref)
+            if not inflight:
+                break
+            ref = inflight.popleft()
+            block = ray.get(ref, timeout=300)
+            del ref                     # consumed: reclaimable NOW
+            yield block
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from block
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(len(b) for b in self.iter_blocks())
+
+
+def stream_range(n: int, *, block_size: int = 1000,
+                 window: int = 4) -> DataStream:
+    """A streaming source of ``range(n)`` in ``block_size`` blocks."""
+    def source():
+        for lo in range(0, n, block_size):
+            yield list(range(lo, min(lo + block_size, n)))
+    return DataStream(source, window=window)
+
+
+def stream_from_items(items: list, *, block_size: int = 1000,
+                      window: int = 4) -> DataStream:
+    items = list(items)
+
+    def source():
+        for lo in range(0, len(items), block_size):
+            yield items[lo:lo + block_size]
+    return DataStream(source, window=window)
+
+
+def stream_blocks(make_blocks: Callable[[], Iterable[list]], *,
+                  window: int = 4) -> DataStream:
+    """A streaming source from any block-yielding callable (runs INSIDE
+    the generator task — e.g. read files lazily)."""
+    return DataStream(make_blocks, window=window)

@@ -93,6 +93,9 @@ class HeadNode:
             "kv": self._kv,
             "refs_flush": self._refs_flush,
             "client_bye": self._client_bye,
+            "stream_wait": self._stream_wait,
+            "stream_ack": self._stream_ack,
+            "stream_close": self._stream_close,
             "status": self._status,
             "nodes": self._nodes,
             "available_resources": self._available_resources,
@@ -135,6 +138,18 @@ class HeadNode:
 
     def _client_bye(self, job_bin: bytes) -> None:
         self._rt.cluster.ref_counter.holder_gone(("c", job_bin))
+
+    def _stream_wait(self, task_bin: bytes, index: int,
+                     timeout: float | None):
+        sealed, done, error = self._rt.stream_wait(TaskID(task_bin),
+                                                   index, timeout)
+        return sealed, done, serialize(error) if error else None
+
+    def _stream_ack(self, task_bin: bytes, consumed: int) -> None:
+        self._rt.stream_ack(TaskID(task_bin), consumed)
+
+    def _stream_close(self, task_bin: bytes, consumed: int) -> None:
+        self._rt.stream_close(TaskID(task_bin), consumed)
 
     def _fn_register(self, fn_id: str, fn_bytes: bytes) -> None:
         self._rt.fn_registry.setdefault(fn_id, fn_bytes)

@@ -288,6 +288,17 @@ class NodeAgent:
         if kind in ("error", "actor_error"):
             self._release_exec_pins(index, msg[1])
             return msg
+        if kind == "stream_item":
+            # ("stream_item", tid, idx, payload, contained): big items
+            # seal into the LOCAL arena; metadata rides up
+            if len(msg[3]) > self.store._threshold:
+                oid = ObjectID.for_task_return(TaskID(msg[1]), msg[2])
+                self.store.put_serialized(oid, msg[3])
+                k, size = self.store.plasma_info(oid)
+                if k in ("shm", "spill"):
+                    return ("stream_item_x", msg[1], msg[2],
+                            ("p", oid.binary(), size), msg[4])
+            return msg
         if kind == "put":
             if len(msg[2]) > self.store._threshold:
                 oid = ObjectID(msg[1])

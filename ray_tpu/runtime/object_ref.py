@@ -85,6 +85,86 @@ def ref_collector():
         _collect.refs = prev
 
 
+class ObjectRefGenerator:
+    """The consumer's side of a streaming-generator task
+    (``num_returns="streaming"``): iterating yields ObjectRefs for the
+    generator's items as they seal, with consumption acks driving the
+    producer's backpressure window (reference: ``ObjectRefGenerator``,
+    core worker streaming-generator protocol — SURVEY.md §1 layer 7;
+    mount empty).
+
+    The runtime must expose ``stream_wait(task_id, index, timeout)`` ->
+    (sealed, done, error) and ``stream_ack(task_id, consumed)`` — the
+    driver implements them on the TaskManager; the head proxies them
+    for clients."""
+
+    def __init__(self, task_id, runtime=None):
+        self._task_id = task_id
+        self._rt = runtime
+        self._i = 0
+        self._closed = False
+
+    def _runtime(self):
+        if self._rt is None:    # deserialized: rebind to this process
+            from .. import api
+            self._rt = api._get_runtime()
+        return self._rt
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        rt = self._runtime()
+        sealed, done, error = rt.stream_wait(self._task_id, self._i,
+                                             2.0)
+        if self._i >= sealed and not done:
+            # no progress in the grace window: re-ack our position (a
+            # retried producer restarts with an empty ack table and
+            # only this unblocks its backpressure), then wait for real
+            rt.stream_ack(self._task_id, self._i)
+            sealed, done, error = rt.stream_wait(self._task_id,
+                                                 self._i, None)
+        if self._i >= sealed:
+            self.close()
+            if error is not None:
+                raise error.cause if getattr(error, "cause", None) \
+                    else error
+            raise StopIteration
+        self._i += 1
+        from ..common.ids import ObjectID
+        ref = ObjectRef(ObjectID.for_task_return(self._task_id,
+                                                 self._i))
+        rt.stream_ack(self._task_id, self._i)
+        return ref
+
+    def close(self) -> None:
+        """Finish with the stream: cancels a still-running producer and
+        reclaims sealed-but-unconsumed items.  Called automatically at
+        exhaustion and at garbage collection."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._runtime().stream_close(self._task_id, self._i)
+        except Exception:   # noqa: BLE001 — teardown/GC: best-effort
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001
+            pass
+
+    @property
+    def task_id(self):
+        return self._task_id
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id, None))
+
+
 def serialize_collecting(value) -> tuple[bytes, list[bytes]]:
     """Serialize ``value`` and return (payload, binary ids of every
     ObjectRef pickled inside it) — the shared form of the

@@ -377,6 +377,13 @@ class Raylet:
             round_t0 = time.monotonic()
             try:
                 self._reconcile_assigned()
+                # the timed wake must ALSO run the stale-lease recall:
+                # a task committed behind a long-running (never-blocking)
+                # holder has no other wake-up to pull it back
+                with self._cv:
+                    any_assigned = self._assigned_total > 0
+                if any_assigned:
+                    self._spill_stale_leases()
                 if batch:
                     leftover = self._place_batch(batch)
                     if leftover:
@@ -933,6 +940,7 @@ class Raylet:
         rec.lineage_bytes = len(payload) + 256
         self._task_start[spec.task_id.binary()] = time.time()
         worker.leased_task = spec.task_id.binary()
+        worker.leased_streaming = spec.num_returns == -1
         with self._cv:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
                                                     pinned)
@@ -1186,20 +1194,26 @@ class Raylet:
         worker.dead = True
         self._enqueue(rec.spec.task_id)
 
+    def _seal_error_returns(self, rec, err) -> None:
+        """Seal ``err`` into every live return (seal before complete —
+        see the result handler); a streaming generator additionally
+        finishes its stream with the error so blocked consumers wake."""
+        for oid in rec.return_ids:
+            if oid not in rec.dead_returns:
+                self.store.put(oid, err)
+        if rec.spec.num_returns == -1:
+            self.task_manager.stream_finished(rec.spec.task_id, err)
+
     def _fail_unscheduled(self, rec, message: str) -> None:
         """Fail a task that never reached dispatch (no resources were
         subtracted, no worker leased)."""
         err = RayTaskError(rec.spec.function_descriptor, message)
-        for oid in rec.return_ids:           # seal before complete (see
-            if oid not in rec.dead_returns:  # _on_worker_message result)
-                self.store.put(oid, err)
+        self._seal_error_returns(rec, err)
         self.task_manager.complete(rec.spec.task_id)
 
     def _finish_with_error(self, rec, error: RayTaskError,
                            worker: WorkerHandle | None) -> None:
-        for oid in rec.return_ids:           # seal before complete (see
-            if oid not in rec.dead_returns:  # _on_worker_message result)
-                self.store.put(oid, error)
+        self._seal_error_returns(rec, error)
         self.task_manager.complete(rec.spec.task_id)
         self.crm.add_back(self.row, rec.spec.resources)
         if worker is not None:
@@ -1293,10 +1307,7 @@ class Raylet:
                                          else None)
                     self._seal_results_x(rec, msg[2])
                 else:
-                    err = deserialize(msg[2])
-                    for oid in rec.return_ids:
-                        if oid not in rec.dead_returns:
-                            self.store.put(oid, err)
+                    self._seal_error_returns(rec, deserialize(msg[2]))
                 self.task_manager.complete(task_id)
                 self.crm.add_back(self.row, rec.spec.resources)
             # pipelined lease: ship the next committed task from THIS
@@ -1379,6 +1390,41 @@ class Raylet:
                             o, size, self.row, PullPriority.WAIT)
             worker.send(("wait_reply",
                          serialize([o.binary() for o in ready])))
+        elif kind == "stream_item":
+            # ("stream_item", tid_bin, index, payload, contained):
+            # one yielded item of a streaming generator seals NOW
+            from ..common.ids import ObjectID as _OID
+            tid = TaskID(msg[1])
+            oid = _OID.for_task_return(tid, msg[2])
+            rec = self.task_manager.get(tid)
+            if rec is not None and oid not in rec.dead_returns \
+                    and not rec.stream_closed:
+                self._register_contained(oid, msg[4])
+                self.cluster.seal_serialized(oid, msg[3], self.row)
+                self.task_manager.stream_item_sealed(tid, msg[2])
+        elif kind == "stream_item_x":
+            # plane mode: the agent sealed the payload into its arena
+            # (the descriptor is always ("p", oid_bin, size) — small
+            # items stay plain stream_item frames)
+            from ..common.ids import ObjectID as _OID
+            tid = TaskID(msg[1])
+            oid = _OID.for_task_return(tid, msg[2])
+            rec = self.task_manager.get(tid)
+            d = msg[3]
+            if rec is None or oid in rec.dead_returns \
+                    or rec.stream_closed or d[0] != "p":
+                # dropped item: the agent's arena copy is orphaned —
+                # free it (mirrors _seal_results_x's dead-return path)
+                if d[0] == "p" and self.plane_address is not None:
+                    self.cluster.plane.free_on(self.plane_address,
+                                               [oid])
+            else:
+                self._register_contained(oid, msg[4])
+                self.cluster.directory.add_location(oid, self.row)
+                self.store.put_remote(oid, d[2])
+                self.task_manager.stream_item_sealed(tid, msg[2])
+        elif kind == "stream_end":
+            self.task_manager.stream_finished(TaskID(msg[1]))
         elif kind == "refs":
             # this worker's batched local incref/decref events fold
             # against its holder entry (distributed refcounting)
@@ -1645,9 +1691,7 @@ class Raylet:
                 "worker died", WorkerCrashedError(
                     f"worker {worker.index} died executing "
                     f"{rec.spec.function_descriptor}"))
-            for oid in rec.return_ids:       # seal before complete (see
-                if oid not in rec.dead_returns:  # result handler)
-                    self.store.put(oid, err)
+            self._seal_error_returns(rec, err)
             self.task_manager.complete(task_id)
         self._notify_dirty()
 
@@ -1661,10 +1705,29 @@ class Raylet:
             return
         err = RayTaskError(rec.spec.function_descriptor, "cancelled",
                            TaskCancelledError())
-        for oid in rec.return_ids:
-            if oid not in rec.dead_returns:
-                self.store.put(oid, err)
+        self._seal_error_returns(rec, err)
         self.task_manager.complete(task_id)
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> bool:
+        """Relay a consumer's progress to the generator's worker so its
+        backpressure window slides; False when the task is not running
+        here (best-effort — a stalled ack only pauses the producer)."""
+        with self._cv:
+            entry = self._running.get(task_id.binary())
+        if entry is None:
+            return False
+        entry[1].send(("stream_ack", task_id.binary(), consumed))
+        return True
+
+    def stream_cancel(self, task_id: TaskID) -> bool:
+        """Cooperative stop for a running generator: it ends its stream
+        at the next backpressure check instead of yielding further."""
+        with self._cv:
+            entry = self._running.get(task_id.binary())
+        if entry is None:
+            return False
+        entry[1].send(("stream_cancel", task_id.binary()))
+        return True
 
     def cancel(self, task_id: TaskID, force: bool = False) -> bool:
         from .serialization import TaskCancelledError
@@ -1756,9 +1819,7 @@ class Raylet:
                 err = RayTaskError(
                     rec.spec.function_descriptor, "node removed",
                     WorkerCrashedError("node died"))
-                for oid in rec.return_ids:   # seal before complete (see
-                    if oid not in rec.dead_returns:  # result handler)
-                        self.store.put(oid, err)
+                self._seal_error_returns(rec, err)
                 self.task_manager.complete(task_id)
         self.pool.shutdown()
 

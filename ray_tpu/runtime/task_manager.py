@@ -29,11 +29,18 @@ class TaskRecord:
     recovering: bool = False        # a reconstruction resubmit is in flight
     lineage_bytes: int = 0          # retained-spec cost while done
     dead_returns: set = field(default_factory=set)
+    # streaming-generator state (spec.num_returns == -1): highest sealed
+    # item index, whether the generator finished, and its error if any
+    stream_sealed: int = 0
+    stream_done: bool = False
+    stream_error: object = None
+    stream_closed: bool = False     # consumer finished/abandoned it
 
 
 class TaskManager:
     def __init__(self):
         self._lock = threading.Lock()
+        self._stream_cv = threading.Condition()     # stream progress
         self._records: dict[TaskID, TaskRecord] = {}
         # completed records in retention order (lineage eviction is FIFO:
         # oldest finished task loses reconstructability first)
@@ -43,8 +50,11 @@ class TaskManager:
         self.lineage_evictions = 0
 
     def register(self, spec: TaskSpec) -> TaskRecord:
+        # streaming generators (num_returns == -1) have no fixed return
+        # set: items seal incrementally as the generator yields
+        n = max(spec.num_returns, 0)
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
-                      for i in range(spec.num_returns)]
+                      for i in range(n)]
         rec = TaskRecord(spec, spec.max_retries, return_ids)
         with self._lock:
             self._records[spec.task_id] = rec
@@ -92,7 +102,8 @@ class TaskManager:
                 # never dispatched (failed pre-dispatch): flat floor — the
                 # dispatch path stamps the real serialized size
                 rec.lineage_bytes = 256
-            if rec.dead_returns.issuperset(rec.return_ids):
+            if rec.return_ids and \
+                    rec.dead_returns.issuperset(rec.return_ids):
                 # nothing downstream can ever need this lineage
                 del self._records[task_id]
                 return rec
@@ -102,11 +113,21 @@ class TaskManager:
             return rec
 
     def _evict_over_budget_locked(self) -> None:
+        # records of OPEN streams are pinned: evicting one mid-iteration
+        # would silently truncate the consumer's stream (wait_stream
+        # reads unknown records as "ended")
+        skipped = []
         while self._lineage_bytes > self._budget and self._done:
             tid, rec = self._done.popitem(last=False)
+            if rec.spec.num_returns == -1 and not rec.stream_closed:
+                skipped.append((tid, rec))
+                continue
             self._lineage_bytes -= rec.lineage_bytes
             self._records.pop(tid, None)
             self.lineage_evictions += 1
+        for tid, rec in reversed(skipped):
+            self._done[tid] = rec
+            self._done.move_to_end(tid, last=False)
 
     def on_return_reclaimed(self, object_id: ObjectID) -> None:
         """A return object went out of scope cluster-wide: once ALL of a
@@ -119,7 +140,8 @@ class TaskManager:
             if rec is None:
                 return
             rec.dead_returns.add(object_id)
-            if rec.done and rec.dead_returns.issuperset(rec.return_ids):
+            if rec.done and rec.return_ids and \
+                    rec.dead_returns.issuperset(rec.return_ids):
                 del self._records[tid]
                 if self._done.pop(tid, None) is not None:
                     self._lineage_bytes -= rec.lineage_bytes
@@ -156,6 +178,68 @@ class TaskManager:
             rec.retries_left -= 1
             rec.spec.attempt_number += 1
             return True
+
+    # -- streaming generators -----------------------------------------------
+    def stream_item_sealed(self, task_id: TaskID, index: int) -> None:
+        """Item ``index`` (1-based) of a generator task sealed.  Uses
+        max() so a retrying re-execution's re-seals are idempotent."""
+        with self._stream_cv:
+            rec = self._records.get(task_id)
+            if rec is not None:
+                rec.stream_sealed = max(rec.stream_sealed, index)
+            self._stream_cv.notify_all()
+
+    def stream_finished(self, task_id: TaskID, error=None) -> None:
+        with self._stream_cv:
+            rec = self._records.get(task_id)
+            if rec is not None:
+                rec.stream_done = True
+                if error is not None and rec.stream_error is None:
+                    rec.stream_error = error
+            self._stream_cv.notify_all()
+
+    def wait_stream(self, task_id: TaskID, index: int,
+                    timeout: float | None = None):
+        """Block until item ``index+1`` exists or the stream finished.
+        Returns (sealed, done, error); (0, True, None) for an unknown
+        record (evicted => treat as ended)."""
+        import time
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._stream_cv:
+            while True:
+                rec = self._records.get(task_id)
+                if rec is None:
+                    return 0, True, None
+                if rec.stream_sealed > index or rec.stream_done:
+                    return (rec.stream_sealed, rec.stream_done,
+                            rec.stream_error)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return (rec.stream_sealed, rec.stream_done,
+                                rec.stream_error)
+                    self._stream_cv.wait(remaining)
+                else:
+                    self._stream_cv.wait()
+
+    def stream_close(self, task_id: TaskID, consumed: int) -> list:
+        """The consumer is done with a stream (exhausted it or abandoned
+        it): unpin the record for lineage eviction and return the ids of
+        sealed-but-unconsumed items for the caller to reclaim.  Those
+        ids also become dead returns so a producer retry cannot re-seal
+        them."""
+        with self._stream_cv:
+            rec = self._records.get(task_id)
+            if rec is None:
+                return []
+            rec.stream_closed = True
+            orphans = [ObjectID.for_task_return(task_id, i)
+                       for i in range(consumed + 1,
+                                      rec.stream_sealed + 1)]
+            rec.dead_returns.update(orphans)
+            self._stream_cv.notify_all()
+        return orphans
 
     def pending_count(self) -> int:
         with self._lock:

@@ -116,6 +116,12 @@ class WorkerApiContext:
         self._api_lock = threading.RLock()
         self._flush_lock = threading.Lock()
         self._reply_q: queue.SimpleQueue = queue.SimpleQueue()
+        # streaming-generator backpressure: highest consumer-acked item
+        # per task (fed by the reader thread's stream_ack routing)
+        self._stream_acks: dict[bytes, int] = {}
+        self._stream_active: set[bytes] = set()
+        self._stream_cancelled: set[bytes] = set()
+        self._stream_cv = threading.Condition()
 
     # -- transport ----------------------------------------------------------
     def send(self, msg) -> None:
@@ -132,6 +138,19 @@ class WorkerApiContext:
                 break
             if msg[0] in _REPLY_KINDS:
                 self._reply_q.put(msg)
+            elif msg[0] == "stream_ack":
+                # out-of-band: the main thread is inside the generator.
+                # Only ACTIVE streams record (a late ack after
+                # stream_done must not re-create the entry)
+                with self._stream_cv:
+                    if msg[1] in self._stream_active:
+                        prev = self._stream_acks.get(msg[1], 0)
+                        self._stream_acks[msg[1]] = max(prev, msg[2])
+                        self._stream_cv.notify_all()
+            elif msg[0] == "stream_cancel":
+                with self._stream_cv:
+                    self._stream_cancelled.add(msg[1])
+                    self._stream_cv.notify_all()
             else:
                 work_q.put(msg)
         work_q.put(None)
@@ -184,6 +203,32 @@ class WorkerApiContext:
             if msg[0] in expected_kinds:
                 return msg
             # stale reply (an abandoned earlier call): drop it
+
+    def stream_begin(self, task_id_bin: bytes) -> None:
+        with self._stream_cv:
+            self._stream_active.add(task_id_bin)
+            self._stream_cancelled.discard(task_id_bin)
+
+    def stream_wait_budget(self, task_id_bin: bytes, produced: int,
+                           window: int) -> bool:
+        """Generator backpressure: pause until the consumer has acked
+        within ``window`` of what we produced.  The wait is indefinite —
+        a slow-but-alive consumer keeps memory bounded, and an ABANDONED
+        stream cancels cooperatively (ObjectRefGenerator close/GC sends
+        stream_cancel).  Returns False when cancelled: stop yielding."""
+        with self._stream_cv:
+            while produced - self._stream_acks.get(task_id_bin, 0) \
+                    >= window:
+                if task_id_bin in self._stream_cancelled:
+                    return False
+                self._stream_cv.wait(1.0)
+            return task_id_bin not in self._stream_cancelled
+
+    def stream_done(self, task_id_bin: bytes) -> None:
+        with self._stream_cv:
+            self._stream_acks.pop(task_id_bin, None)
+            self._stream_active.discard(task_id_bin)
+            self._stream_cancelled.discard(task_id_bin)
 
     # -- task lifecycle (called by the exec paths) --------------------------
     def begin_task(self, task_id: TaskID):
@@ -258,6 +303,22 @@ class WorkerApiContext:
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
         self.flush_refs()
         self.send(("submit", serialize(spec), fn_id, fn_bytes))
+
+    # streaming-generator consumption is driver-side (v1): a worker
+    # holding an ObjectRefGenerator surfaces a clear error instead of
+    # silently hanging
+    def stream_wait(self, task_id, index, timeout=None):
+        raise RuntimeError(
+            "ObjectRefGenerator consumption inside a worker is not "
+            "supported; consume the stream in the driver")
+
+    def stream_ack(self, task_id, consumed) -> None:
+        raise RuntimeError(
+            "ObjectRefGenerator consumption inside a worker is not "
+            "supported; consume the stream in the driver")
+
+    def stream_close(self, task_id, consumed) -> None:
+        pass        # nothing held worker-side (see stream_wait)
 
     def kv_op(self, op: str, key: bytes, value: bytes | None = None,
               namespace: str = "", overwrite: bool = True):
@@ -544,23 +605,52 @@ def worker_main(conn, worker_index: int,
                 _scope = None
             try:
                 out = fn(*args, **kwargs)
-                if num_returns == 1:
-                    results = [out]
-                elif num_returns == 0:
-                    results = []
+                if num_returns == -1:
+                    # streaming generator: each yielded item seals
+                    # incrementally; the consumer's acks drive
+                    # backpressure so at most ``window`` unconsumed
+                    # items exist at once (reference: streaming
+                    # generator protocol, num_returns="streaming")
+                    from ..common.config import get_config
+                    window = max(
+                        get_config().streaming_backpressure_items, 1)
+                    ctx.stream_begin(task_id_bin)
+                    idx = 0
+                    try:
+                        for item in out:
+                            idx += 1
+                            data, inner = serialize_collecting(item)
+                            ctx.send(("stream_item", task_id_bin, idx,
+                                      data, inner))
+                            item = data = inner = None
+                            if not ctx.stream_wait_budget(
+                                    task_id_bin, idx, window):
+                                break   # consumer closed the stream
+                    finally:
+                        if hasattr(out, "close"):
+                            out.close()     # GeneratorExit into user code
+                        ctx.stream_done(task_id_bin)
+                    ctx.send(("stream_end", task_id_bin, idx))
+                    ctx.send(("result", task_id_bin, [], []))
                 else:
-                    results = list(out)
-                    if len(results) != num_returns:
-                        raise ValueError(
-                            f"task {name} declared num_returns="
-                            f"{num_returns} but returned {len(results)} "
-                            "values")
-                payloads, contained = [], []
-                for r in results:
-                    data, inner = serialize_collecting(r)
-                    payloads.append(data)
-                    contained.append(inner)
-                ctx.send(("result", task_id_bin, payloads, contained))
+                    if num_returns == 1:
+                        results = [out]
+                    elif num_returns == 0:
+                        results = []
+                    else:
+                        results = list(out)
+                        if len(results) != num_returns:
+                            raise ValueError(
+                                f"task {name} declared num_returns="
+                                f"{num_returns} but returned "
+                                f"{len(results)} values")
+                    payloads, contained = [], []
+                    for r in results:
+                        data, inner = serialize_collecting(r)
+                        payloads.append(data)
+                        contained.append(inner)
+                    ctx.send(("result", task_id_bin, payloads,
+                              contained))
             except BaseException as e:  # noqa: BLE001 — any task failure
                 err = RayTaskError.from_exception(name, e)
                 try:

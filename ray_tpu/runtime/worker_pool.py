@@ -44,13 +44,28 @@ class LocalSpawner:
         with _spawn_env_lock:
             saved = {k: os.environ.pop(k) for k in _SCRUB_ENV
                      if k in os.environ}
+            # export THIS process's resolved config as RT_* env vars so
+            # the spawned worker (fresh interpreter) rebuilds the same
+            # Config — programmatic system_config overrides would
+            # otherwise silently vanish at the process boundary
+            cfg_saved = {}
             try:
+                from ..common.config import get_config
+                for key, val in get_config().to_dict().items():
+                    env_key = "RT_" + key.upper()
+                    cfg_saved[env_key] = os.environ.get(env_key)
+                    os.environ[env_key] = str(val)
                 proc = self._ctx.Process(
                     target=worker_main,
                     args=(child_conn, index, arena_path, env_payload),
                     daemon=True, name=f"rt-worker-{index}")
                 proc.start()
             finally:
+                for env_key, old in cfg_saved.items():
+                    if old is None:
+                        os.environ.pop(env_key, None)
+                    else:
+                        os.environ[env_key] = old
                 os.environ.update(saved)
         child_conn.close()
         return proc, parent_conn
@@ -72,6 +87,10 @@ class WorkerHandle:
         self.env_key = None                 # runtime-env cache key
         self.env_payload = None             # staged payload (respawn)
         self.leased_task = None             # task_id_bin while executing
+        # executing a streaming generator: it can pause indefinitely on
+        # consumer backpressure, so tasks must never pipeline behind it
+        # (the consumer may be waiting on exactly the queued task)
+        self.leased_streaming = False
         # pipelined lease: (TaskID, assign_time) entries committed to
         # this worker but NOT yet sent — recallable (blocked worker,
         # stale lease, death) until the exec frame ships.  Mutated under
@@ -285,6 +304,7 @@ class WorkerPool:
             best = None
             for h in self._workers:
                 if h.dead or h.dedicated or h.blocked or \
+                        h.leased_streaming or \
                         h.env_key != env_key or h.leased_task is None:
                     continue
                 if len(h.assigned) >= depth - 1:
@@ -296,6 +316,7 @@ class WorkerPool:
     def release(self, handle: WorkerHandle) -> None:
         with self._cv:
             handle.leased_task = None
+            handle.leased_streaming = False
             if not handle.dead and handle not in self._idle:
                 self._idle.append(handle)
                 self._cv.notify_all()

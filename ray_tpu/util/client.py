@@ -134,6 +134,24 @@ class ClientRuntime:
                    serialize((args, kwargs, trace_ctx,
                               concurrency_group)), num_returns)
 
+    def stream_wait(self, task_id, index: int,
+                    timeout: float | None = None):
+        # bounded server-side waits so one stream doesn't pin an RPC
+        # worker thread forever; loop client-side for timeout=None
+        while True:
+            sealed, done, err_bytes = self._call(
+                "stream_wait", task_id.binary(), index,
+                30.0 if timeout is None else timeout)
+            err = deserialize(err_bytes) if err_bytes else None
+            if sealed > index or done or timeout is not None:
+                return sealed, done, err
+
+    def stream_ack(self, task_id, consumed: int) -> None:
+        self._call("stream_ack", task_id.binary(), consumed)
+
+    def stream_close(self, task_id, consumed: int) -> None:
+        self._call("stream_close", task_id.binary(), consumed)
+
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         self._call("kill_actor", actor_id.binary(), no_restart)
 
