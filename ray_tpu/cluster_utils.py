@@ -383,7 +383,11 @@ class Cluster:
         snap = {"named_actors": named,
                 "fn_registry": dict(self.fn_registry),
                 "kv": self.kv.snapshot()}
-        tmp = path + ".tmp"
+        # writer-unique tmp name: two concurrent savers (persist tick
+        # vs final stop snapshot) must not truncate each other's file
+        # and promote a torn pickle
+        import threading as _threading
+        tmp = f"{path}.tmp.{os.getpid()}.{_threading.get_ident()}"
         with open(tmp, "wb") as f:
             pickle.dump(snap, f)
         os.replace(tmp, path)       # atomic: no torn snapshot

@@ -32,7 +32,15 @@ class HeadNode:
                  num_workers: int | None = None,
                  system_config: dict | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 xlang_port: int | None = 0):
+                 xlang_port: int | None = 0,
+                 persist_path: str | None = None):
+        """``persist_path`` enables head fault tolerance: the GCS
+        metadata plane (KV incl. the job table, fn registry, named-actor
+        specs) snapshots there periodically, and a restarted daemon
+        restores it — agents reconnect and interrupted jobs re-run
+        (reference: Redis-backed GCS FT, SURVEY.md §5.4; divergence
+        noted in JobManager.restore_jobs)."""
+        import os
         from .. import api
         from ..rpc import RpcServer
         from ..rpc.xlang_gateway import XlangGateway
@@ -42,6 +50,10 @@ class HeadNode:
         self._rt = api._get_runtime()
         self._lock = threading.Lock()
         self.jobs = JobManager(self._rt.cluster.session_dir)
+        self.jobs.attach_kv(self._rt.cluster.kv)
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            self._rt.cluster.restore_gcs_snapshot(persist_path)
         self.server = RpcServer(self._handlers(), host=host, port=port)
         self.server.start()
         # cross-language surface (C++ frontend); xlang_port=None disables
@@ -57,6 +69,28 @@ class HeadNode:
         self.agent_hub = AgentHub(self._rt.cluster)
         self.agent_hub.attach(self.server)
         self._stop_event = threading.Event()
+        # interrupted jobs re-run AFTER the control surface is up (their
+        # drivers reconnect through it)
+        if persist_path and os.path.exists(persist_path):
+            self.jobs.head_address = self.server.address
+            self.jobs.restore_jobs()
+        self._persist_lock = threading.Lock()
+        if persist_path:
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True,
+                name="head-persist")
+            self._persist_thread.start()
+
+    def _snapshot(self) -> None:
+        with self._persist_lock:    # serialize vs the final stop save
+            self._rt.cluster.save_gcs_snapshot(self._persist_path)
+
+    def _persist_loop(self) -> None:
+        while not self._stop_event.wait(2.0):
+            try:
+                self._snapshot()
+            except Exception:   # noqa: BLE001 — a failed snapshot must
+                pass            # not kill the daemon; next tick retries
 
     @property
     def address(self) -> str:
@@ -66,7 +100,16 @@ class HeadNode:
         return self._stop_event.wait(timeout)
 
     def stop(self) -> None:
-        self.jobs.stop_all()
+        # stop jobs FIRST so their terminal statuses land in the final
+        # snapshot — a job the operator shut down must not persist as
+        # RUNNING and get resurrected by the next start
+        self.jobs.stop_all(wait=True)
+        if self._persist_path:
+            try:    # final snapshot: clean stops restore losslessly
+                self._stop_event.set()      # persist loop stands down
+                self._snapshot()
+            except Exception:   # noqa: BLE001
+                pass
         self.agent_hub.shutdown()
         if self.xlang is not None:
             self.xlang.stop()

@@ -24,14 +24,15 @@ import time
 class JobInfo:
     __slots__ = ("job_id", "entrypoint", "status", "metadata",
                  "start_time", "end_time", "log_path", "proc",
-                 "return_code")
+                 "return_code", "runtime_env")
 
     def __init__(self, job_id: str, entrypoint: str, metadata: dict,
-                 log_path: str):
+                 log_path: str, runtime_env: dict | None = None):
         self.job_id = job_id
         self.entrypoint = entrypoint
         self.status = "PENDING"
         self.metadata = metadata
+        self.runtime_env = runtime_env
         self.start_time = time.time()
         self.end_time: float | None = None
         self.log_path = log_path
@@ -41,8 +42,20 @@ class JobInfo:
     def to_dict(self) -> dict:
         return {"job_id": self.job_id, "entrypoint": self.entrypoint,
                 "status": self.status, "metadata": self.metadata,
+                "runtime_env": self.runtime_env,
                 "start_time": self.start_time, "end_time": self.end_time,
                 "return_code": self.return_code}
+
+
+def _pid_runs_job(pid: int, job_id: str) -> bool:
+    """Identity check before SIGKILLing a persisted driver pid: the OS
+    may have recycled it for an unrelated process.  Drivers carry
+    RAY_TPU_JOB_ID in their environment (set at submit)."""
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            return f"RAY_TPU_JOB_ID={job_id}".encode() in f.read()
+    except OSError:
+        return False
 
 
 class JobManager:
@@ -53,17 +66,83 @@ class JobManager:
         self._lock = threading.Lock()
         self._counter = 0
         self.head_address: str | None = None    # set by HeadNode
+        self._kv = None                 # GCS KV: job rows ride snapshots
+
+    # -- persistence (head failover) ----------------------------------------
+    def attach_kv(self, kv) -> None:
+        """Persist job rows into the GCS KV so they ride its snapshots
+        (reference: job table lives in the GCS — SURVEY.md §1 layer 3)."""
+        self._kv = kv
+
+    def _persist(self, info: JobInfo) -> None:
+        if self._kv is None:
+            return
+        import json
+        row = info.to_dict()
+        row["pid"] = info.proc.pid if info.proc is not None else None
+        self._kv.put(info.job_id.encode(), json.dumps(row).encode(),
+                     namespace="_jobs")
+
+    def restore_jobs(self) -> list[str]:
+        """After a head restart: re-run jobs that were PENDING/RUNNING
+        when the old head died (their driver processes died with it, or
+        are orphans we reap below).  Finished rows restore as history.
+        Returns the re-submitted job ids.
+
+        Divergence from upstream, documented: a Redis-FT GCS keeps
+        raylets and drivers alive across the restart; here the runtime
+        state lives in the head process, so interrupted jobs re-execute
+        from their entrypoints."""
+        import json
+        import signal
+        if self._kv is None:
+            return []
+        resubmitted = []
+        for key in self._kv.keys(namespace="_jobs"):
+            raw = self._kv.get(key, namespace="_jobs")
+            if not raw:
+                continue
+            row = json.loads(raw)
+            old_pid = row.pop("pid", None)
+            if row["status"] in ("PENDING", "RUNNING"):
+                if old_pid and _pid_runs_job(old_pid, row["job_id"]):
+                    try:    # reap the orphaned driver of the old head
+                        os.kill(old_pid, signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+                self.submit(row["entrypoint"],
+                            runtime_env=row.get("runtime_env"),
+                            metadata=row["metadata"],
+                            job_id=row["job_id"])
+                resubmitted.append(row["job_id"])
+            else:
+                info = JobInfo(row["job_id"], row["entrypoint"],
+                               row["metadata"], os.path.join(
+                                   self._log_dir,
+                                   f"job-{row['job_id']}.log"),
+                               runtime_env=row.get("runtime_env"))
+                info.status = row["status"]
+                info.start_time = row["start_time"]
+                info.end_time = row["end_time"]
+                info.return_code = row["return_code"]
+                with self._lock:
+                    self._jobs.setdefault(row["job_id"], info)
+        return resubmitted
 
     def submit(self, entrypoint: str, runtime_env: dict | None = None,
-               metadata: dict | None = None) -> str:
+               metadata: dict | None = None,
+               job_id: str | None = None) -> str:
         cmd = shlex.split(entrypoint)
         if not cmd:
             raise ValueError("empty job entrypoint")
-        with self._lock:
-            self._counter += 1
-            job_id = f"raysubmit_{self._counter:06d}_{os.urandom(4).hex()}"
+        if job_id is None:
+            with self._lock:
+                self._counter += 1
+                job_id = \
+                    f"raysubmit_{self._counter:06d}_{os.urandom(4).hex()}"
         log_path = os.path.join(self._log_dir, f"job-{job_id}.log")
-        info = JobInfo(job_id, entrypoint, metadata or {}, log_path)
+        info = JobInfo(job_id, entrypoint, metadata or {}, log_path,
+                       runtime_env=runtime_env)
         with self._lock:
             self._jobs[job_id] = info
         env = dict(os.environ)
@@ -92,8 +171,10 @@ class JobManager:
             log_f.close()
             info.status = "FAILED"
             info.end_time = time.time()
+            self._persist(info)
             return job_id
         info.status = "RUNNING"
+        self._persist(info)
         threading.Thread(target=self._reap, args=(info, log_f),
                          daemon=True, name=f"job-{job_id}").start()
         return job_id
@@ -106,6 +187,7 @@ class JobManager:
             info.end_time = time.time()
             if info.status != "STOPPED":
                 info.status = "SUCCEEDED" if rc == 0 else "FAILED"
+        self._persist(info)
 
     def status(self, job_id: str) -> dict:
         info = self._jobs.get(job_id)
@@ -137,13 +219,23 @@ class JobManager:
             return True
         return False
 
-    def stop_all(self) -> None:
+    def stop_all(self, wait: bool = False) -> None:
         with self._lock:
             jobs = list(self._jobs.values())
         for j in jobs:
             if j.proc is not None and j.proc.poll() is None:
                 j.status = "STOPPED"
                 j.proc.terminate()
+                self._persist(j)
+        if wait:
+            # a final snapshot follows: terminal statuses must land in
+            # the KV first, or the next start resurrects stopped jobs
+            for j in jobs:
+                if j.proc is not None:
+                    try:
+                        j.proc.wait(timeout=3.0)
+                    except subprocess.TimeoutExpired:
+                        j.proc.kill()
 
     def wait(self, job_id: str, timeout: float = 120.0) -> dict:
         """Block until the job leaves PENDING/RUNNING (test helper)."""

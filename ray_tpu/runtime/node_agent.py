@@ -91,14 +91,31 @@ class NodeAgent:
                  resources: dict[str, float] | None = None,
                  num_workers: int = 2,
                  labels: dict[str, str] | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 reconnect_timeout_s: float = 0.0):
+        """``reconnect_timeout_s`` > 0 makes the agent survive a head
+        restart: on link loss it retries the head address for that long
+        and re-registers as a fresh node (local workers of the dead
+        head's pool are reaped, the local store resets — the restarted
+        head has no directory entries for it)."""
         from ..rpc import RpcClient, RpcServer
         from .object_plane import ObjectPlane
         from .object_store import MemoryStore
+        self._head_address = head_address
+        self._resources = resources
+        self._num_workers = num_workers
+        self._labels = labels
+        self._reconnect_timeout = reconnect_timeout_s
         self._spawner = LocalSpawner()
         self._workers: dict[int, tuple] = {}    # index -> (proc, conn)
         self._lock = threading.Lock()
         self._stop_event = threading.Event()
+        self._stopping = False
+        self._reconnecting = False
+        # registration epoch: pump threads of a PREVIOUS head's workers
+        # must not relay frames/EOFs to the re-registered head (their
+        # indices collide with the new pool's)
+        self._epoch = 0
         # local object plane: own arena + spill dir
         self._session_dir = tempfile.mkdtemp(prefix="ray_tpu_agent_")
         self._arena = _make_agent_arena(self._session_dir)
@@ -124,18 +141,101 @@ class NodeAgent:
         self.server = RpcServer(handlers, host=host, port=port).start()
         self.plane.serve_address = self.server.address
         # head link: frames flow agent->head on this client; its loss
-        # (head died) ends the agent — workers without a head are orphans
-        self._head = RpcClient(head_address,
-                               on_close=self._stop_event.set)
-        self.agent_id = NodeID.from_random().hex()
-        self.node_id_hex = self._head.call(
-            "agent_register", self.agent_id, self.server.address,
-            resources, num_workers, labels, True)
+        # (head died) ends the agent — or, with reconnect enabled,
+        # triggers the retry/re-register loop.  The INITIAL registration
+        # retries under the same budget: a head dying mid-register must
+        # not strand a reconnect-enabled agent
+        import time as _time
+        deadline = _time.monotonic() + max(reconnect_timeout_s, 0.0)
+        self._reconnecting = True   # a mid-register drop must not fork
+        try:                        # a racing reconnect loop
+            while True:
+                try:
+                    self._head = RpcClient(head_address,
+                                           on_close=self._on_head_lost)
+                    self.agent_id = NodeID.from_random().hex()
+                    self.node_id_hex = self._head.call(
+                        "agent_register", self.agent_id,
+                        self.server.address, resources, num_workers,
+                        labels, True)
+                    break
+                except Exception:
+                    if _time.monotonic() >= deadline:
+                        raise
+                    with self._lock:    # epoch bump quiets stale pumps
+                        self._epoch += 1
+                        self._workers.clear()
+                    _time.sleep(1.0)
+        finally:
+            with self._lock:
+                self._reconnecting = False
+
+    # -- head failover -------------------------------------------------------
+    def _on_head_lost(self) -> None:
+        if self._stopping or self._reconnect_timeout <= 0:
+            self._stop_event.set()
+            return
+        with self._lock:
+            if self._reconnecting:
+                return      # one loop at a time: a client that drops
+            self._reconnecting = True   # mid-register must not fork a
+            #                             racing second registration
+        threading.Thread(target=self._reconnect_loop, daemon=True,
+                         name="agent-reconnect").start()
+
+    def _reconnect_loop(self) -> None:
+        """The head died: reap the dead pool's local workers, reset the
+        local store (the restarted head has no directory rows for it),
+        and re-register as a fresh node until the timeout lapses."""
+        import time
+        from ..rpc import RpcClient
+        deadline = time.monotonic() + self._reconnect_timeout
+        # new epoch FIRST: surviving pump threads of the dead head's
+        # workers go quiet instead of relaying colliding indices
+        with self._lock:
+            self._epoch += 1
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for proc, conn in workers:
+            try:
+                proc.terminate()
+            except Exception:   # noqa: BLE001
+                pass
+        with self._pin_lock:
+            self._exec_pins.clear()
+            self._get_pins.clear()
+        self.store.delete([oid for oid, _s, _k
+                           in self.store.list_objects()])
+        try:
+            while time.monotonic() < deadline and not self._stopping:
+                head = None
+                try:
+                    head = RpcClient(self._head_address,
+                                     on_close=self._on_head_lost)
+                    # install the link BEFORE registering: the register
+                    # call blocks on worker-ready frames, which the new
+                    # pump threads relay through self._head/agent_id
+                    self._head = head
+                    self.agent_id = NodeID.from_random().hex()
+                    self.node_id_hex = self._head.call(
+                        "agent_register", self.agent_id,
+                        self.server.address, self._resources,
+                        self._num_workers, self._labels, True)
+                    return      # rejoined
+                except Exception:   # noqa: BLE001 — head still down
+                    if head is not None:
+                        head.close()
+                    time.sleep(1.0)
+            self._stop_event.set()
+        finally:
+            with self._lock:
+                self._reconnecting = False
 
     def wait_for_shutdown(self, timeout: float | None = None) -> bool:
         return self._stop_event.wait(timeout)
 
     def stop(self) -> None:
+        self._stopping = True
         try:
             self._head.call("agent_bye", self.agent_id, timeout=5.0)
         except Exception:       # noqa: BLE001 — head may already be gone
@@ -150,7 +250,8 @@ class NodeAgent:
                                          env_payload)
         with self._lock:
             self._workers[index] = (proc, conn)
-        threading.Thread(target=self._pump, args=(index, conn),
+            epoch = self._epoch
+        threading.Thread(target=self._pump, args=(index, conn, epoch),
                          daemon=True, name=f"agent-pump-{index}").start()
         return proc.pid or 0
 
@@ -358,12 +459,15 @@ class NodeAgent:
             self.store.unpin(pins)
 
     # -- worker->head pump ---------------------------------------------------
-    def _pump(self, index: int, conn) -> None:
+    def _pump(self, index: int, conn, epoch: int = 0) -> None:
         while True:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            if self._epoch != epoch:
+                return      # stale worker of a replaced head: its index
+                #             collides with the new pool's — go quiet
             try:
                 msg = self._rewrite_up(index, msg)
             except Exception:   # noqa: BLE001 — surgery must not drop
@@ -373,6 +477,8 @@ class NodeAgent:
             except Exception:   # noqa: BLE001 — head gone: nothing to
                 return          # relay to; the on_close hook is already
                 #                 ending the agent
+        if self._epoch != epoch:
+            return          # stale: do NOT EOF the new pool's worker
         self._release_index_pins(index)
         try:
             self._head.call("agent_eof", self.agent_id, index)

@@ -57,7 +57,8 @@ def cmd_head(args) -> int:
     from ..runtime.head import HeadNode
     resources = json.loads(args.resources) if args.resources else None
     head = HeadNode(resources=resources, num_workers=args.num_workers,
-                    port=args.port)
+                    port=args.port,
+                    persist_path=getattr(args, "persist", None))
     _write_address(head.address)
     print(f"ray_tpu head listening on {head.address}", flush=True)
     if head.xlang is not None:
@@ -78,7 +79,9 @@ def cmd_agent(args) -> int:
     labels = json.loads(args.labels) if args.labels else None
     num_workers = args.num_workers if args.num_workers is not None else 2
     agent = NodeAgent(args.address, resources=resources,
-                      num_workers=num_workers, labels=labels)
+                      num_workers=num_workers, labels=labels,
+                      reconnect_timeout_s=getattr(
+                          args, "reconnect_timeout", 60.0))
     print(f"ray_tpu node agent joined {args.address} as node "
           f"{agent.node_id_hex[:16]}… ({num_workers} workers)",
           flush=True)
@@ -355,6 +358,9 @@ def build_parser() -> argparse.ArgumentParser:
     ph.add_argument("--port", type=int, default=0)
     ph.add_argument("--resources", default=None)
     ph.add_argument("--num-workers", type=int, default=None)
+    ph.add_argument("--persist", default=None,
+                    help="GCS snapshot path: enables head fault "
+                         "tolerance (restore on restart)")
     ph.set_defaults(fn=cmd_head)
 
     ps = sub.add_parser("start", help="start cluster daemons")
@@ -379,6 +385,9 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--resources", default=None)
     pa.add_argument("--num-workers", type=int, default=2)
     pa.add_argument("--labels", default=None, help="JSON node labels")
+    pa.add_argument("--reconnect-timeout", type=float, default=60.0,
+                    help="seconds to retry a lost head before exiting "
+                         "(0 disables; survives head restarts)")
     pa.set_defaults(fn=cmd_agent)
 
     pst = sub.add_parser("stop", help="stop the running cluster")
