@@ -178,10 +178,21 @@ class ActorClass:
             cur = rt.current_task_id
             job_id = cur.job_id() if cur else JobID.from_int(0)
             actor_id = ActorID.of(job_id)
+        lifetime = opts.get("lifetime")
+        if lifetime not in (None, "ephemeral", "detached"):
+            raise ValueError(
+                f"lifetime must be 'detached', 'ephemeral', or "
+                f"omitted; got {lifetime!r}")
+        namespace = opts.get("namespace")
+        if namespace is None:
+            # None (not "") from a WORKER runtime: the raylet fills in
+            # the job's default namespace cluster-side
+            namespace = getattr(rt, "namespace", None)
         rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
                         max_restarts, max_task_retries, name, resources,
                         strategy, opts.get("runtime_env"),
-                        concurrency=concurrency)
+                        concurrency=concurrency, namespace=namespace,
+                        lifetime=lifetime)
         return ActorHandle(actor_id)
 
 

@@ -149,21 +149,27 @@ class DriverRuntime:
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
                      resources=None, strategy=None,
-                     runtime_env=None, concurrency=None) -> None:
+                     runtime_env=None, concurrency=None,
+                     namespace="", lifetime=None) -> None:
         self.actor_manager.create_actor(actor_id, cls_id, cls_bytes, args,
                                         kwargs, max_restarts,
                                         max_task_retries, name,
                                         resources=resources,
                                         strategy=strategy,
                                         runtime_env=runtime_env,
-                                        concurrency=concurrency)
+                                        concurrency=concurrency,
+                                        namespace=namespace,
+                                        lifetime=lifetime)
 
     def shutdown(self) -> None:
         # an adopted (caller-owned) cluster stays up across shutdown, the
         # reference's detach semantics; the caller stops it via
-        # cluster.stop()
+        # cluster.stop().  This JOB still ends: its ephemeral actors die
+        # with it (detached ones keep running on the adopted cluster)
         if self._owns_cluster:
             self.cluster.stop()
+        elif self.actor_manager is not None:
+            self.actor_manager.on_job_exit(self.job_id.binary())
 
 
 # ---------------------------------------------------------------------------
@@ -385,14 +391,17 @@ def init(resources: dict[str, float] | None = None,
          system_config: dict | None = None,
          runtime_env: dict | None = None,
          address: str | None = None,
-         cluster=None) -> None:
+         cluster=None, namespace: str | None = None) -> None:
     """Start the runtime.  ``cluster=`` adopts an existing simulated
     multi-node ``cluster_utils.Cluster`` (the reference's
     ``ray.init(address=cluster.address)`` pattern); ``runtime_env=`` is
     the job-level default environment for every task; ``address=`` (or
     ``"auto"`` with ``RAY_TPU_ADDRESS`` set) attaches to a running head
     daemon as a CLIENT instead of starting a local cluster (reference:
-    ``ray.init("ray://…")``)."""
+    ``ray.init("ray://…")``); ``namespace=`` scopes named-actor
+    lookup/registration (divergence from upstream, documented: the
+    default is the SHARED "" namespace rather than an anonymous
+    per-job one — explicit namespaces give the isolation)."""
     global _runtime
     with _lock:
         if _runtime is not None:
@@ -415,7 +424,8 @@ def init(resources: dict[str, float] | None = None,
                     f"{bad} configure a LOCAL cluster and would be "
                     "silently ignored — drop them or drop address")
             from .util.client import ClientRuntime
-            _runtime = ClientRuntime(address, runtime_env=runtime_env)
+            _runtime = ClientRuntime(address, runtime_env=runtime_env,
+                                     namespace=namespace)
             return
         if system_config is not None:
             Config.reset(system_config)
@@ -428,6 +438,9 @@ def init(resources: dict[str, float] | None = None,
                 min(int(resources.get("CPU", ncpu)), ncpu)
         _runtime = DriverRuntime(JobID.next(), resources, num_workers,
                                  cluster=cluster)
+        _runtime.namespace = namespace or ""
+        # workers inherit the job's namespace through the cluster
+        _runtime.cluster.default_namespace = namespace or ""
         # the cluster carries the job-level default env: EVERY spec
         # intake (driver submits, worker-submitted children, actor
         # creation) merges against it, so inheritance is uniform
@@ -494,18 +507,23 @@ def kill(actor_handle, *, no_restart: bool = True) -> None:
         rt.kill_actor(actor_handle._actor_id, no_restart=no_restart)
 
 
-def get_actor(name: str):
-    """Look up a named actor (reference: ``ray.get_actor``)."""
+def get_actor(name: str, namespace: str | None = None):
+    """Look up a named actor, scoped to the caller's namespace unless
+    one is given (reference: ``ray.get_actor(name, namespace=...)``)."""
     from .actor_api import ActorHandle
     from .common.ids import ActorID
     rt = _get_runtime()
+    ns = namespace if namespace is not None \
+        else getattr(rt, "namespace", None)
     if rt.is_driver:
-        aid = rt.actor_manager.get_by_name(name)
+        aid = rt.actor_manager.get_by_name(name, ns or "")
     else:
-        raw = rt.get_actor_id_by_name(name)
+        # workers pass None: the raylet resolves the job's default
+        raw = rt.get_actor_id_by_name(name, ns)
         aid = ActorID(raw) if raw else None
     if aid is None:
-        raise ValueError(f"no actor named {name!r}")
+        raise ValueError(f"no actor named {name!r} in namespace "
+                         f"{(ns or '')!r}")
     return ActorHandle(aid)
 
 

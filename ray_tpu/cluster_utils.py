@@ -112,6 +112,9 @@ class Cluster:
         from .runtime.runtime_env import RuntimeEnvManager
         self.runtime_env_manager = RuntimeEnvManager(self.session_dir)
         self.job_runtime_env = None           # set by api.init(runtime_env=)
+        self.default_namespace = ""           # set by api.init(namespace=):
+        #   worker-side named-actor ops inherit it (workers carry no
+        #   namespace of their own)
         # node-bandwidth matrix (MB/s) — the pull cost model's input;
         # grows with the CRM row space
         self.bandwidth_mbps = np.zeros((0, 0), dtype=np.int32)
@@ -420,7 +423,9 @@ class Cluster:
         job_id = JobID.next()
         skipped = []
         for spec in snap["named_actors"]:
-            if self.actor_manager.get_by_name(spec["name"]) is not None:
+            ns = spec.get("namespace", "")
+            if self.actor_manager.get_by_name(spec["name"],
+                                              ns) is not None:
                 skipped.append(spec["name"])    # live actor wins
                 continue
             args, kwargs = deserialize(spec["init"])
@@ -429,7 +434,8 @@ class Cluster:
                 self.fn_registry.get(spec["cls_id"]), args, kwargs,
                 spec["max_restarts"], spec["max_task_retries"],
                 spec["name"], resources=spec["resources"],
-                runtime_env=spec["runtime_env"])
+                runtime_env=spec["runtime_env"],
+                namespace=ns, lifetime=spec.get("lifetime"))
         if skipped:
             self.events.emit("gcs", "restore_skipped_actors",
                              names=skipped)

@@ -108,6 +108,12 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 4,
         "Concurrent transfer executors in the pull manager; activation "
         "stays quota-bounded (pull_manager_max_inflight_mb)."),
+    "runtime_env_wheelhouse": (
+        str, "",
+        "Local wheel directory for runtime_env pip provisioning: "
+        "requirements install offline (pip --no-index --find-links) "
+        "into a digest-keyed cached package dir workers import from. "
+        "'' => validation-only (requirements must already be present)."),
     "streaming_backpressure_items": (
         int, 16,
         "Streaming-generator window: a generator task pauses once this "

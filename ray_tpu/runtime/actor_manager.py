@@ -73,6 +73,13 @@ class ActorRecord:
     # to the worker's _ActorExecutor; widens the pipelining window so
     # a concurrent actor actually receives overlapping calls
     concurrency: dict | None = None
+    # named-actor namespace ("" = the shared default namespace —
+    # explicit namespaces isolate, reference ray.init(namespace=...))
+    namespace: str = ""
+    # "detached" actors outlive their creating job: a client disconnect
+    # kills its ephemeral actors but leaves detached ones running
+    # (reference lifetime="detached", GcsActorManager detached handling)
+    lifetime: str = "ephemeral"
     state: ActorState = ActorState.PENDING
     worker = None
     pool = None                 # worker pool of the placement node
@@ -90,7 +97,8 @@ class ActorManager:
         self._fn_registry = cluster.fn_registry
         self._lock = threading.RLock()
         self._actors: dict[ActorID, ActorRecord] = {}
-        self._names: dict[str, ActorID] = {}
+        # (namespace, name) -> actor id
+        self._names: dict[tuple[str, str], ActorID] = {}
 
     # -- creation -----------------------------------------------------------
     def create_actor(self, actor_id: ActorID, cls_id: str,
@@ -100,9 +108,15 @@ class ActorManager:
                      resources: ResourceRequest | None = None,
                      strategy: SchedulingStrategy | None = None,
                      runtime_env: dict | None = None,
-                     concurrency: dict | None = None) -> None:
+                     concurrency: dict | None = None,
+                     namespace: str = "",
+                     lifetime: str | None = None) -> None:
         if cls_bytes is not None:
             self._fn_registry.setdefault(cls_id, cls_bytes)
+        lifetime = lifetime or "ephemeral"
+        if lifetime == "detached" and name is None:
+            raise ValueError(
+                "detached actors must be named (reference requirement)")
         from .runtime_env import merge_runtime_env
         rec = ActorRecord(actor_id, cls_id, args, kwargs, max_restarts,
                           max_task_retries, name,
@@ -110,13 +124,18 @@ class ActorManager:
                           strategy=strategy or SchedulingStrategy(),
                           runtime_env=merge_runtime_env(
                               self._cluster.job_runtime_env, runtime_env),
-                          concurrency=concurrency)
+                          concurrency=concurrency,
+                          namespace=namespace or "",
+                          lifetime=lifetime)
         rec.restarts_left = max_restarts
         with self._lock:
             if name is not None:
-                if name in self._names:
-                    raise ValueError(f"actor name {name!r} already taken")
-                self._names[name] = actor_id
+                nkey = (rec.namespace, name)
+                if nkey in self._names:
+                    raise ValueError(
+                        f"actor name {name!r} already taken in "
+                        f"namespace {rec.namespace!r}")
+                self._names[nkey] = actor_id
             self._actors[actor_id] = rec
         self._resolve_then(args, lambda: self._start_incarnation(rec))
 
@@ -502,7 +521,7 @@ class ActorManager:
             if not can_restart:
                 rec.queue.clear()
                 if rec.name is not None:
-                    self._names.pop(rec.name, None)
+                    self._names.pop((rec.namespace, rec.name), None)
         # in-flight calls: retry (front of queue, original order) or fail
         err = RayTaskError(
             "actor task", "actor died",
@@ -569,7 +588,7 @@ class ActorManager:
             queued = list(rec.queue)
             rec.queue.clear()
             if rec.name is not None:
-                self._names.pop(rec.name, None)
+                self._names.pop((rec.namespace, rec.name), None)
         if doomed is not None:
             self._kill_reaped(doomed)
         err = init_error if init_error is not None else RayTaskError(
@@ -608,7 +627,7 @@ class ActorManager:
         queued = list(rec.queue)
         rec.queue.clear()
         if rec.name is not None:
-            self._names.pop(rec.name, None)
+            self._names.pop((rec.namespace, rec.name), None)
         err = RayTaskError(
             "actor task", "actor was killed",
             ActorDiedError(f"actor {rec.actor_id.hex()[:12]} was killed"))
@@ -631,9 +650,23 @@ class ActorManager:
             worker.dead = True
             self.on_worker_death(worker)
 
-    def get_by_name(self, name: str) -> ActorID | None:
+    def get_by_name(self, name: str,
+                    namespace: str = "") -> ActorID | None:
         with self._lock:
-            return self._names.get(name)
+            return self._names.get((namespace or "", name))
+
+    def on_job_exit(self, job_bin: bytes) -> None:
+        """A driver/client job ended: its EPHEMERAL actors die with it;
+        detached actors live until explicitly killed (reference:
+        GcsActorManager destroys a job's non-detached actors on job
+        death — SURVEY.md §3.4)."""
+        with self._lock:
+            doomed = [rec.actor_id for rec in self._actors.values()
+                      if rec.lifetime != "detached"
+                      and rec.state is not ActorState.DEAD
+                      and rec.actor_id.job_id().binary() == job_bin]
+        for actor_id in doomed:
+            self.kill(actor_id, no_restart=True)
 
     def state_of(self, actor_id: ActorID) -> ActorState | None:
         with self._lock:
@@ -660,7 +693,9 @@ class ActorManager:
                     "max_restarts": rec.max_restarts,
                     "max_task_retries": rec.max_task_retries,
                     "resources": rec.resources,
-                    "runtime_env": rec.runtime_env})
+                    "runtime_env": rec.runtime_env,
+                    "namespace": rec.namespace,
+                    "lifetime": rec.lifetime})
             return out
 
     def list_actors(self) -> list[dict]:

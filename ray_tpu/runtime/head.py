@@ -171,8 +171,13 @@ class HeadNode:
             if job_runtime_env and not self._rt.cluster.job_runtime_env:
                 self._rt.cluster.job_runtime_env = job_runtime_env
         counter = self._rt.cluster.ref_counter
-        self.server.on_conn_close(
-            lambda: counter.holder_gone(("c", job_id.binary())))
+        am = self._rt.actor_manager
+
+        def on_gone(job_bin=job_id.binary()):
+            counter.holder_gone(("c", job_bin))
+            # the job's EPHEMERAL actors die with it; detached survive
+            am.on_job_exit(job_bin)
+        self.server.on_conn_close(on_gone)
         return {"job_id": job_id.binary(),
                 "session_dir": self._rt.cluster.session_dir}
 
@@ -247,7 +252,12 @@ class HeadNode:
         from .object_ref import counter_suppressed
         with counter_suppressed():      # see _submit_spec
             unpacked = deserialize(payload)
-        if len(unpacked) == 9:
+        namespace, lifetime = "", None
+        if len(unpacked) == 11:
+            (args, kwargs, max_restarts, max_task_retries, name, res,
+             strategy, runtime_env, concurrency, namespace,
+             lifetime) = unpacked
+        elif len(unpacked) == 9:
             (args, kwargs, max_restarts, max_task_retries, name, res,
              strategy, runtime_env, concurrency) = unpacked
         else:               # pre-concurrency client
@@ -258,7 +268,8 @@ class HeadNode:
                               args, kwargs, max_restarts,
                               max_task_retries, name, resources=res,
                               strategy=strategy, runtime_env=runtime_env,
-                              concurrency=concurrency)
+                              concurrency=concurrency,
+                              namespace=namespace, lifetime=lifetime)
 
     def _submit_actor_call(self, actor_bin: bytes, task_bin: bytes,
                            method: str, payload: bytes,
@@ -279,8 +290,9 @@ class HeadNode:
         self._rt.actor_manager.kill(ActorID(actor_bin),
                                     no_restart=no_restart)
 
-    def _get_actor_by_name(self, name: str) -> bytes | None:
-        aid = self._rt.actor_manager.get_by_name(name)
+    def _get_actor_by_name(self, name: str,
+                           namespace: str = "") -> bytes | None:
+        aid = self._rt.actor_manager.get_by_name(name, namespace)
         return aid.binary() if aid is not None else None
 
     def _cancel(self, task_bin: bytes, force: bool) -> None:

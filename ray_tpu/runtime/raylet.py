@@ -1230,7 +1230,12 @@ class Raylet:
             if kind == "actor_create":
                 from ..common.ids import ActorID
                 unpacked = deserialize(msg[4])
-                if len(unpacked) == 9:
+                namespace, lifetime = None, None
+                if len(unpacked) == 11:
+                    (args, kwargs, max_restarts, max_task_retries, name,
+                     res, strategy, runtime_env, concurrency, namespace,
+                     lifetime) = unpacked
+                elif len(unpacked) == 9:
                     (args, kwargs, max_restarts, max_task_retries, name,
                      res, strategy, runtime_env, concurrency) = unpacked
                 else:       # pre-concurrency frame shape
@@ -1244,11 +1249,14 @@ class Raylet:
                     from .runtime_env import merge_runtime_env
                     runtime_env = merge_runtime_env(parent_env,
                                                     runtime_env)
+                if namespace is None:   # worker default: job namespace
+                    namespace = self.cluster.default_namespace
                 am.create_actor(ActorID(msg[1]), msg[2], msg[3], args,
                                 kwargs, max_restarts, max_task_retries,
                                 name, resources=res, strategy=strategy,
                                 runtime_env=runtime_env,
-                                concurrency=concurrency)
+                                concurrency=concurrency,
+                                namespace=namespace, lifetime=lifetime)
                 return
             if kind == "actor_submit":
                 from ..common.ids import ActorID
@@ -1267,7 +1275,10 @@ class Raylet:
                 am.kill(ActorID(msg[1]), no_restart=msg[2])
                 return
             if kind == "named_actor":
-                aid = am.get_by_name(msg[1])
+                ns = msg[2] if len(msg) > 2 else None
+                if ns is None:      # worker default: the job's namespace
+                    ns = self.cluster.default_namespace
+                aid = am.get_by_name(msg[1], ns)
                 worker.send(("named_actor_reply",
                              aid.binary() if aid else None))
                 return

@@ -10,11 +10,17 @@ In-process form: the ``RuntimeEnvManager`` stages into
 ``<session>/runtime_resources/<digest>/`` (content-addressed cache, the
 URI-cache analogue) and produces a *payload* the spawned worker applies
 at startup (env vars, chdir into the staged working_dir, sys.path for
-py_modules).  ``pip``/``conda`` requests are validated against the
-already-present interpreter environment — this deployment is
-zero-egress, so a requirement that is not importable fails staging with
-``RuntimeEnvSetupError`` (the reference surfaces the same error type
-when provisioning fails).
+py_modules).
+
+``pip`` requests REALLY provision when ``runtime_env_wheelhouse``
+points at a local wheel directory: requirements install offline
+(``pip install --no-index --find-links <wheelhouse> --target``) into a
+digest-keyed package dir that workers put on ``sys.path`` — per-env
+package isolation without egress, cache hits skip the install, and an
+unsatisfiable requirement fails staging with ``RuntimeEnvSetupError``
+(the reference's pip plugin provisions a virtualenv the same way).
+Without a wheelhouse, pip/conda requests are validated against the
+already-present interpreter environment (zero-egress fallback).
 """
 
 from __future__ import annotations
@@ -86,6 +92,8 @@ class RuntimeEnvManager:
         self._errors: dict[str, str] = {}       # key -> staging error
         self._inflight: dict[str, threading.Event] = {}  # key -> staging
         self.num_staged = 0
+        self.num_pip_installs = 0       # real provisioning runs (cache
+        #                                 hits do NOT increment)
 
     def get_if_ready(self, key: str | None) -> dict | None:
         """Cached payload for an env key, or None while unstaged/staging
@@ -150,8 +158,8 @@ class RuntimeEnvManager:
             if not isinstance(k, str) or not isinstance(v, str):
                 raise RuntimeEnvSetupError(
                     f"env_vars must be str->str, got {k!r}: {v!r}")
-        self._check_requirements(env)
         stage_dir = os.path.join(self._root, key)
+        self._provision_pip(env, stage_dir, payload)
         wd = env.get("working_dir")
         if wd:
             if not os.path.isdir(wd):
@@ -177,6 +185,64 @@ class RuntimeEnvManager:
             # of a copied package dir and the holder of a copied file
             payload["py_modules"].append(os.path.dirname(dst))
         return payload
+
+    @staticmethod
+    def _pip_requirements(env: dict) -> list[str]:
+        """Requirement strings in PIP syntax.  Conda dependencies
+        translate: interpreter pins (``python=3.x``) drop, and conda's
+        single-``=`` version pins become pip ``==`` pins."""
+        import re
+        reqs = list(env.get("pip") or [])
+        conda = env.get("conda")
+        if isinstance(conda, dict):
+            for d in conda.get("dependencies", ()):
+                if not isinstance(d, str):
+                    continue
+                name = re.split(r"[=<>!~\[;\s]", d.strip(), 1)[0]
+                if name == "python":
+                    continue
+                # name=1.2 (conda) -> name==1.2 (pip); leave ==/>=/etc
+                reqs.append(re.sub(r"(?<![=<>!~])=(?!=)", "==", d))
+        return reqs
+
+    def _provision_pip(self, env: dict, stage_dir: str,
+                       payload: dict) -> None:
+        """Install pip requirements OFFLINE from the configured local
+        wheelhouse into ``<stage>/pip_pkgs`` (digest-keyed: a cache hit
+        skips the install entirely) and put it on the worker path.
+        Falls back to present-interpreter validation when no wheelhouse
+        is configured (reference: ``python/ray/_private/runtime_env/``
+        pip plugin; SURVEY.md §1 layer 10 — mount empty)."""
+        import subprocess
+        import sys
+
+        from ..common.config import get_config
+        reqs = self._pip_requirements(env)
+        if not reqs:
+            return
+        wheelhouse = get_config().runtime_env_wheelhouse
+        if not wheelhouse:
+            self._check_requirements(env)
+            return
+        target = os.path.join(stage_dir, "pip_pkgs")
+        if not os.path.isdir(target):
+            tmp = target + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "install", "--no-index",
+                 "--find-links", wheelhouse, "--target", tmp,
+                 "--quiet", *reqs],
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                tail = (proc.stderr or proc.stdout or "").strip()
+                raise RuntimeEnvSetupError(
+                    f"pip provisioning failed for {reqs!r} from "
+                    f"wheelhouse {wheelhouse!r}: {tail[-800:]}")
+            os.makedirs(stage_dir, exist_ok=True)
+            os.rename(tmp, target)      # visible only when complete
+            self.num_pip_installs += 1
+        payload["py_modules"].append(target)
 
     def _check_requirements(self, env: dict) -> None:
         """Zero-egress pip/conda: requirements must already be present in
@@ -214,7 +280,8 @@ class RuntimeEnvManager:
         with self._lock:
             return {"num_staged": self.num_staged,
                     "num_cached": len(self._cache),
-                    "num_failed": len(self._errors)}
+                    "num_failed": len(self._errors),
+                    "num_pip_installs": self.num_pip_installs}
 
 
 def apply_payload(payload: dict | None) -> None:

@@ -334,13 +334,14 @@ class WorkerApiContext:
     def create_actor(self, actor_id, cls_id: str, cls_bytes: bytes | None,
                      args, kwargs, max_restarts: int, max_task_retries: int,
                      name: str | None, resources=None, strategy=None,
-                     runtime_env=None, concurrency: dict | None = None):
+                     runtime_env=None, concurrency: dict | None = None,
+                     namespace: str = "", lifetime: str | None = None):
         self.flush_refs()
         self.send(("actor_create", actor_id.binary(), cls_id,
                    cls_bytes, serialize(
                        (args, kwargs, max_restarts, max_task_retries,
                         name, resources, strategy, runtime_env,
-                        concurrency))))
+                        concurrency, namespace, lifetime))))
 
     # -- placement groups (frames handled by the raylet) --------------------
     def create_placement_group(self, pg_id, bundles, strategy_name: str,
@@ -364,9 +365,9 @@ class WorkerApiContext:
     def kill_actor(self, actor_id, no_restart: bool = True):
         self.send(("actor_kill", actor_id.binary(), no_restart))
 
-    def get_actor_id_by_name(self, name: str):
+    def get_actor_id_by_name(self, name: str, namespace: str = ""):
         with self._api_lock:
-            self.send(("named_actor", name))
+            self.send(("named_actor", name, namespace))
             return self._recv_reply("named_actor_reply")[1]
 
 

@@ -46,9 +46,11 @@ class _RemoteFnRegistry:
 class ClientRuntime:
     is_driver = False
 
-    def __init__(self, address: str, runtime_env: dict | None = None):
+    def __init__(self, address: str, runtime_env: dict | None = None,
+                 namespace: str | None = None):
         from ..rpc import RpcClient
         self.address = address
+        self.namespace = namespace or ""
         self._rpc = RpcClient(address)
         self._lock = threading.Lock()
         # this process's share of distributed refcounting: ObjectRefs
@@ -119,11 +121,13 @@ class ClientRuntime:
     def create_actor(self, actor_id, cls_id, cls_bytes, args, kwargs,
                      max_restarts, max_task_retries, name,
                      resources=None, strategy=None,
-                     runtime_env=None, concurrency=None) -> None:
+                     runtime_env=None, concurrency=None,
+                     namespace="", lifetime=None) -> None:
         self._call("create_actor", actor_id.binary(), cls_id, cls_bytes,
                    serialize((args, kwargs, max_restarts,
                               max_task_retries, name, resources,
-                              strategy, runtime_env, concurrency)))
+                              strategy, runtime_env, concurrency,
+                              namespace, lifetime)))
 
     def submit_actor_call(self, actor_id, task_id, method: str, args,
                           kwargs, num_returns: int,
@@ -155,8 +159,9 @@ class ClientRuntime:
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         self._call("kill_actor", actor_id.binary(), no_restart)
 
-    def get_actor_id_by_name(self, name: str) -> bytes | None:
-        return self._call("get_actor_by_name", name)
+    def get_actor_id_by_name(self, name: str,
+                             namespace: str = "") -> bytes | None:
+        return self._call("get_actor_by_name", name, namespace)
 
     def cancel_task(self, task_id, force: bool = False) -> None:
         self._call("cancel", task_id.binary(), force)

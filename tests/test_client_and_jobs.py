@@ -150,6 +150,8 @@ class TestClientMode:
         assert "cpu 4.0" in out
 
     def test_named_actor_across_clients(self, head):
+        # detached: survives client 1's disconnect (reference: ephemeral
+        # actors die with their job; only detached outlive it)
         run_client_driver(head, """
             @ray_tpu.remote
             class Registry:
@@ -157,7 +159,8 @@ class TestClientMode:
                     self.v = 'from-client-1'
                 def value(self):
                     return self.v
-            Registry.options(name='shared-reg').remote()
+            Registry.options(name='shared-reg',
+                             lifetime='detached').remote()
         """)
         out = run_client_driver(head, """
             h = ray_tpu.get_actor('shared-reg')
