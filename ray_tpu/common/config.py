@@ -108,6 +108,11 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 4,
         "Concurrent transfer executors in the pull manager; activation "
         "stays quota-bounded (pull_manager_max_inflight_mb)."),
+    "pg_device_batch_min": (
+        int, 2,
+        "Minimum pending placement-group batch routed to the device "
+        "gang-placement kernel (ops/bundle_kernel.py); smaller batches "
+        "use the bit-identical CPU path."),
     "runtime_env_wheelhouse": (
         str, "",
         "Local wheel directory for runtime_env pip provisioning: "

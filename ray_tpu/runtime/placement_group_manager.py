@@ -106,7 +106,7 @@ class PlacementGroupManager:
         self._cluster.ref_counter.pin(ready_oid)
         with self._lock:
             self._groups[pg_id] = rec
-            if not self._try_place(rec):
+            if pg_id not in self._place_many([rec]):
                 self._pending.append(pg_id)
                 self._ensure_ticker()
                 # a group that cannot place is autoscaler demand
@@ -138,7 +138,15 @@ class PlacementGroupManager:
         rows = schedule_bundles(snapshot, dense, rec.strategy, commit=False)
         if rows is None:
             return False
-        # phase 1 — prepare: reserve base resources on each chosen raylet
+        return self._reserve_and_commit(rec, reqs, rows)
+
+    def _reserve_and_commit(self, rec: PlacementGroupRecord, reqs,
+                            rows) -> bool:
+        """2-phase reservation of a computed placement: prepare subtracts
+        base resources on each chosen raylet (re-validated against the
+        LIVE view — a device batch computed on a snapshot may have raced
+        a task), rolling back atomically on any failure; commit surfaces
+        the shaped bundle resources and seals the ready marker."""
         prepared: list[tuple[int, ResourceRequest]] = []
         ok = True
         for b, row in enumerate(rows):
@@ -165,6 +173,51 @@ class PlacementGroupManager:
         self._wake_raylets()
         return True
 
+    def _place_many(self, recs: list) -> set:
+        """Place a batch of pending groups; returns the placed pg ids.
+        Batches at or above ``pg_device_batch_min`` run the device
+        gang-placement kernel in ONE call (bit-identical to sequential
+        ``schedule_bundles`` — the live path of ops/bundle_kernel.py);
+        smaller batches take the per-group CPU path.  Caller holds the
+        lock."""
+        from ..common.config import get_config
+        cfg = get_config()
+        if not (cfg.scheduler_device_backend
+                and len(recs) >= cfg.pg_device_batch_min):
+            return {rec.pg_id for rec in recs if self._try_place(rec)}
+        from ..ops.bundle_kernel import schedule_bundle_groups_np
+        self.device_batches = getattr(self, "device_batches", 0) + 1
+        all_reqs = []
+        for rec in recs:
+            reqs = [ResourceRequest(b) for b in rec.bundles]
+            for r in reqs:
+                self._crm.intern_request(r)
+            all_reqs.append(reqs)
+        width = self._crm.avail.shape[1]
+        B = max(len(r) for r in all_reqs)
+        P = len(recs)
+        bundle_reqs = np.zeros((P, B, width), dtype=np.int32)
+        valid = np.zeros((P, B), dtype=bool)
+        strategies = []
+        for p, reqs in enumerate(all_reqs):
+            for b, r in enumerate(reqs):
+                bundle_reqs[p, b] = r.dense(self._crm.resource_index,
+                                            width)
+                valid[p, b] = True
+            strategies.append(recs[p].strategy)
+        snapshot = self._crm.snapshot()
+        rows, ok, _ = schedule_bundle_groups_np(
+            snapshot.totals, snapshot.avail, snapshot.node_mask,
+            bundle_reqs, valid, strategies)
+        placed = set()
+        for p, rec in enumerate(recs):
+            if not ok[p]:
+                continue
+            group_rows = rows[p, :len(all_reqs[p])]
+            if self._reserve_and_commit(rec, all_reqs[p], group_rows):
+                placed.add(rec.pg_id)
+        return placed
+
     def _wake_raylets(self) -> None:
         for raylet in list(self._cluster.raylets.values()):
             raylet._notify_dirty()
@@ -184,14 +237,13 @@ class PlacementGroupManager:
                     return
                 if self._crm.version != last_version:
                     last_version = self._crm.version
-                    still = []
-                    for pg_id in self._pending:
-                        rec = self._groups.get(pg_id)
-                        if rec is None or rec.state != "PENDING":
-                            continue
-                        if not self._try_place(rec):
-                            still.append(pg_id)
-                    self._pending = still
+                    recs = [self._groups[pg_id]
+                            for pg_id in self._pending
+                            if self._groups.get(pg_id) is not None
+                            and self._groups[pg_id].state == "PENDING"]
+                    placed = self._place_many(recs) if recs else set()
+                    self._pending = [rec.pg_id for rec in recs
+                                     if rec.pg_id not in placed]
             time.sleep(0.05)
 
     # -- node death ---------------------------------------------------------

@@ -415,25 +415,34 @@ class Raylet:
         """
         cfg = get_config()
         specs = [rec.spec for rec in batch]
-        # device path only for large uniform default-strategy batches with
-        # no locality signal; the locality probe (store+directory locks per
-        # arg) runs only when the batch is otherwise device-eligible —
-        # the host path computes it once per spec inside _options_for
+        # ALL-DEFAULT batches take the subgroup route (device or its
+        # host twin): locality bias, lease-avoidance, and top-k are
+        # device surfaces too (ops/locality_kernel.py).  Both backends
+        # process subgroups keyed (class, locality row, avoid) in
+        # first-appearance order, so scheduler_device_batch_min is not
+        # observable in placements (top-k rounds excepted — documented
+        # sampling divergence)
         if (cfg.scheduler_device_backend
-                and cfg.scheduler_top_k_fraction == 0
-                and not self._avoid_local
-                and len(batch) >= cfg.scheduler_device_batch_min
                 and all(s.strategy.kind is SchedulingStrategyKind.DEFAULT
-                        for s in specs)
-                and len({s.scheduling_class() for s in specs})
-                    <= cfg.tpu_group_capacity
-                and all(self._locality_row(s) is None for s in specs)):
-            return self._schedule_rows_device(specs)
-        # per-task CPU policy on a snapshot (sequential within the round),
-        # partitioned by scheduling class in first-appearance order — the
-        # same order the device path's contract uses, so both backends
-        # evolve `avail` identically and the scheduler_device_batch_min
-        # threshold is not observable in placements
+                        for s in specs)):
+            prefs = [self._locality_row(s) if not
+                     (s.task_id in self._avoid_local) else None
+                     for s in specs]
+            avoids = [s.task_id in self._avoid_local for s in specs]
+            with self._cv:      # flags consumed, like the host path
+                for s, a in zip(specs, avoids):
+                    if a:
+                        self._avoid_local.discard(s.task_id)
+            n_sub = len({(s.scheduling_class(), p, a) for s, p, a
+                         in zip(specs, prefs, avoids)})
+            if (len(batch) >= cfg.scheduler_device_batch_min
+                    and n_sub <= cfg.tpu_group_capacity):
+                return self._schedule_rows_device(specs, prefs, avoids)
+            return self._schedule_rows_host_subgrouped(specs, prefs,
+                                                       avoids)
+        # mixed-strategy batches: per-task CPU policy on a snapshot
+        # (sequential within the round), partitioned by scheduling class
+        # in first-appearance order
         snapshot = self._effective_snapshot()
         by_class: dict[tuple, list[int]] = {}
         for t, spec in enumerate(specs):
@@ -451,13 +460,57 @@ class Raylet:
                                       snapshot.node_mask.shape[0]))
         return rows
 
-    def _schedule_rows_device(self, specs: list) -> list[int]:
-        """One device water-fill call places the whole batch (north star)."""
+    def _schedule_rows_host_subgrouped(self, specs, prefs,
+                                       avoids) -> list[int]:
+        """Host twin of the device subgroup path: per-task policy over
+        the SAME (class, pref, avoid) subgroups in first-appearance
+        order — so small rounds and device rounds evolve ``avail``
+        identically (the batch-size threshold stays unobservable) and
+        the locality probe is never run twice."""
+        snapshot = self._effective_snapshot()
+        n_rows = snapshot.node_mask.shape[0]
+        by_sub: dict[tuple, list[int]] = {}
+        for t, spec in enumerate(specs):
+            key = (spec.scheduling_class(),
+                   prefs[t] if prefs[t] is not None else -1, avoids[t])
+            by_sub.setdefault(key, []).append(t)
+        rows = [-1] * len(specs)
+        for (cls_key, pref, avoid), idxs in by_sub.items():
+            req = specs[idxs[0]].resources.dense(
+                self.crm.resource_index, snapshot.totals.shape[1])
+            for t in idxs:
+                if avoid:
+                    opts = SchedulingOptions(avoid_local_node=True,
+                                             local_node_row=self.row)
+                elif pref >= 0:
+                    opts = SchedulingOptions(
+                        scheduling_type=SchedulingType.NODE_AFFINITY,
+                        node_row=int(pref), soft=True)
+                else:
+                    opts = SchedulingOptions()
+                rows[t] = self._policy.schedule(snapshot, req, opts)
+        return rows
+
+    def _schedule_rows_device(self, specs: list,
+                              prefs: list | None = None,
+                              avoids: list | None = None) -> list[int]:
+        """One device water-fill call places the whole batch (north star).
+
+        Subgroups key on (scheduling class, locality row, avoid flag):
+        locality-biased groups pre-place on their preferred row (soft
+        affinity, bit-identical to the host sequence), avoid groups mask
+        out this node, and with ``scheduler_top_k_fraction`` > 0 the
+        no-preference groups spread over their top-k keys on device
+        (documented sampling divergence — ops/locality_kernel.py)."""
         import jax.numpy as jnp
 
         from ..ops import schedule_grouped
         from ..scheduling.contract import threshold_fp
 
+        if prefs is None:
+            prefs = [None] * len(specs)
+        if avoids is None:
+            avoids = [False] * len(specs)
         snapshot = self._effective_snapshot()
         totals, avail, mask = (snapshot.totals, snapshot.avail,
                                snapshot.node_mask)
@@ -465,9 +518,12 @@ class Raylet:
         groups: dict[tuple, int] = {}
         reqs: list[np.ndarray] = []
         counts: list[int] = []
+        pref_rows: list[int] = []
+        avoid_flags: list[bool] = []
         task_group = np.empty(len(specs), dtype=np.int32)
         for t, spec in enumerate(specs):
-            key = spec.scheduling_class()
+            pref = prefs[t] if prefs[t] is not None else -1
+            key = (spec.scheduling_class(), pref, avoids[t])
             g = groups.get(key)
             if g is None:
                 g = len(reqs)
@@ -475,6 +531,8 @@ class Raylet:
                 reqs.append(spec.resources.dense(self.crm.resource_index,
                                                  width))
                 counts.append(0)
+                pref_rows.append(int(pref))
+                avoid_flags.append(bool(avoids[t]))
             counts[g] += 1
             task_group[t] = g
         G, N = len(reqs), totals.shape[0]
@@ -486,17 +544,37 @@ class Raylet:
         req_arr[:G] = np.stack(reqs)
         cnt_arr = np.zeros(Gp, dtype=np.int32)
         cnt_arr[:G] = counts
-        if get_config().scheduler_sharded_state:
+        pref_arr = np.full(Gp, -1, dtype=np.int32)
+        pref_arr[:G] = pref_rows
+        gmask = np.ones((Gp, N), dtype=bool)
+        for g, av in enumerate(avoid_flags):
+            if av and 0 <= self.row < N:
+                gmask[g, self.row] = False
+        cfg = get_config()
+        top_k = cfg.scheduler_top_k_fraction
+        plain = (pref_arr < 0).all() and not any(avoid_flags)
+        if cfg.scheduler_sharded_state and plain and top_k == 0:
             # host gmask: the sharded branch pads its node axis
             counts_host = self._schedule_sharded(
-                totals, avail, mask, req_arr, cnt_arr,
-                np.ones((Gp, N), dtype=bool))[:G]
-        else:
+                totals, avail, mask, req_arr, cnt_arr, gmask)[:G]
+        elif top_k > 0:
+            counts_host = self._schedule_device_topk(
+                totals, avail, mask, req_arr, cnt_arr, gmask, pref_arr,
+                cfg)[:G]
+        elif plain:
             counts_dev, _ = schedule_grouped(
                 jnp.asarray(totals), jnp.asarray(avail),
                 jnp.asarray(mask), jnp.asarray(req_arr),
-                jnp.asarray(cnt_arr), jnp.ones((Gp, N), dtype=bool),
+                jnp.asarray(cnt_arr), jnp.asarray(gmask),
                 jnp.int32(threshold_fp(None)))
+            counts_host = np.asarray(counts_dev)[:G]
+        else:
+            from ..ops.locality_kernel import schedule_grouped_localized
+            counts_dev, _ = schedule_grouped_localized(
+                jnp.asarray(totals), jnp.asarray(avail),
+                jnp.asarray(mask), jnp.asarray(req_arr),
+                jnp.asarray(cnt_arr), jnp.asarray(gmask),
+                jnp.asarray(pref_arr), jnp.int32(threshold_fp(None)))
             counts_host = np.asarray(counts_dev)[:G]
         # expand (G, N+1) counts into per-task rows, class-internal order
         # node-row-ascending (tasks within a class are interchangeable)
@@ -511,6 +589,38 @@ class Raylet:
             rows.append(int(slots[g][cursor[g]]))
             cursor[g] += 1
         return rows
+
+    def _schedule_device_topk(self, totals, avail, mask, req_arr,
+                              cnt_arr, gmask, pref_arr,
+                              cfg) -> "np.ndarray":
+        """Top-k rounds on device: locality groups pre-place via the
+        localized kernel (affinity is deterministic, no sampling), then
+        the remaining groups spread over their top-k keys with a pinned
+        (row, round) random stream — deterministic replay, documented
+        divergence from the host sampler's per-task draws."""
+        from ..ops.locality_kernel import (schedule_grouped_localized_np,
+                                          schedule_grouped_topk_np)
+        self._topk_round = getattr(self, "_topk_round", 0) + 1
+        has_pref = pref_arr >= 0
+        counts_out = np.zeros((req_arr.shape[0], totals.shape[0] + 1),
+                              dtype=np.int32)
+        avail_now = avail
+        if has_pref.any():
+            loc_cnt = np.where(has_pref, cnt_arr, 0).astype(np.int32)
+            c_loc, avail_now = schedule_grouped_localized_np(
+                totals, avail_now, mask, req_arr, loc_cnt, pref_arr,
+                group_masks=gmask, spread_threshold=None)
+            counts_out += c_loc
+        topk_cnt = np.where(has_pref, 0, cnt_arr).astype(np.int32)
+        if topk_cnt.any():
+            c_topk, _ = schedule_grouped_topk_np(
+                totals, avail_now, mask, req_arr, topk_cnt,
+                seed=self.row, round_index=self._topk_round,
+                group_masks=gmask,
+                k_abs=cfg.scheduler_top_k_absolute,
+                k_frac=cfg.scheduler_top_k_fraction)
+            counts_out += c_topk
+        return counts_out
 
     def _schedule_sharded(self, totals, avail, mask, req_arr, cnt_arr,
                           gmask) -> "np.ndarray":

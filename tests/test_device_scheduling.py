@@ -1,0 +1,225 @@
+"""Device scheduling surfaces beyond uniform default batches: live-path
+placement groups, locality-biased batches, and top-k rounds.
+
+Scenario sources: ``bundle_scheduling_policy.cc`` invoked from the GCS
+placement-group scheduler, locality-aware lease targeting, and
+``scheduler_top_k_fraction`` (SURVEY.md §2.5, §3.5; re-derived, not
+copied).  Parity: the localized kernel is bit-identical to the host's
+sequential NodeAffinity-soft + hybrid fallback; top-k is a DOCUMENTED
+divergence (even spread over top-k with a pinned rotation vs the host's
+per-task draws) asserted by property, not bit-equality.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestLocalizedKernelParity:
+    def test_bit_parity_vs_sequential_host_policy(self):
+        """Device localized placement == host per-task NodeAffinity-soft
+        with hybrid fallback, per-node counts bit-equal."""
+        from ray_tpu.common.config import get_config
+        from ray_tpu.ops.locality_kernel import \
+            schedule_grouped_localized_np
+        from ray_tpu.scheduling.cluster_resources import ClusterState
+        from ray_tpu.scheduling.policy import (CompositeSchedulingPolicy,
+                                               SchedulingOptions,
+                                               SchedulingType)
+
+        rng = np.random.default_rng(11)
+        N, R = 24, 4
+        totals = rng.integers(200, 2000, size=(N, R)).astype(np.int32)
+        avail = (totals * rng.random((N, R)) * 0.9).astype(np.int32)
+        mask = np.ones(N, dtype=bool)
+
+        cases = [
+            (np.array([120, 0, 40, 0], np.int32), 30, 3),
+            (np.array([10, 10, 10, 10], np.int32), 50, -1),
+            (np.array([500, 0, 0, 0], np.int32), 12, 7),
+        ]
+        reqs = np.stack([c[0] for c in cases])
+        counts = np.array([c[1] for c in cases], np.int32)
+        prefs = np.array([c[2] for c in cases], np.int32)
+
+        dev_counts, _ = schedule_grouped_localized_np(
+            totals, avail.copy(), mask, reqs, counts, prefs,
+            spread_threshold=None)
+
+        # host: sequential per-task placements evolving avail
+        state = ClusterState(totals.copy(), avail.copy(), mask.copy())
+        policy = CompositeSchedulingPolicy()
+        host_counts = np.zeros((len(cases), N + 1), np.int64)
+        for g, (req, count, pref) in enumerate(cases):
+            for _ in range(count):
+                if pref >= 0:
+                    opts = SchedulingOptions(
+                        scheduling_type=SchedulingType.NODE_AFFINITY,
+                        node_row=int(pref), soft=True)
+                else:
+                    opts = SchedulingOptions()
+                row = policy.schedule(state, req, opts)
+                host_counts[g, row if row >= 0 else N] += 1
+        assert (dev_counts.astype(np.int64) == host_counts).all(), \
+            (dev_counts, host_counts)
+
+
+class TestTopkKernelProperties:
+    def test_even_spread_determinism_conservation(self):
+        from ray_tpu.ops.locality_kernel import schedule_grouped_topk_np
+        N = 12
+        totals = np.full((N, 2), 1000, np.int32)
+        avail = totals.copy()
+        mask = np.ones(N, bool)
+        reqs = np.array([[50, 0]], np.int32)
+        counts = np.array([30], np.int32)
+        c1, _ = schedule_grouped_topk_np(
+            totals, avail, mask, reqs, counts, seed=3, round_index=1,
+            k_abs=1, k_frac=0.25)      # k = ceil(12 * .25) = 3
+        c2, _ = schedule_grouped_topk_np(
+            totals, avail, mask, reqs, counts, seed=3, round_index=1,
+            k_abs=1, k_frac=0.25)
+        assert (c1 == c2).all()                 # pinned stream replays
+        assert c1.sum() == 30                   # conservation
+        placed = c1[0, :N]
+        assert (placed > 0).sum() == 3          # exactly top-k nodes
+        assert placed.max() - placed[placed > 0].min() <= 1  # even
+
+    def test_infeasible_class_overflows(self):
+        from ray_tpu.ops.locality_kernel import schedule_grouped_topk_np
+        totals = np.full((4, 1), 100, np.int32)
+        avail = totals.copy()
+        c, _ = schedule_grouped_topk_np(
+            totals, avail, np.ones(4, bool),
+            np.array([[500]], np.int32), np.array([9], np.int32),
+            seed=0, round_index=0, k_abs=2, k_frac=0.0)
+        assert c[0, 4] == 9         # all in the infeasible column
+
+
+class TestLiveDevicePaths:
+    def test_pg_placement_hits_device_kernel(self):
+        """Live placement groups route through the gang-placement kernel
+        (pg_device_batch_min=1) and keep their semantics."""
+        ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=1,
+                     system_config={"pg_device_batch_min": 1,
+                                    "scheduler_device_batch_min": 10**9})
+        try:
+            from ray_tpu.api import _get_runtime
+            from ray_tpu.util.placement_group import (
+                placement_group, placement_group_table,
+                remove_placement_group)
+            cluster = _get_runtime().cluster
+            n2 = cluster.add_node(resources={"CPU": 4, "memory": 4},
+                                  num_workers=1)
+            n3 = cluster.add_node(resources={"CPU": 4, "memory": 4},
+                                  num_workers=1)
+            pg = placement_group([{"CPU": 2}, {"CPU": 2}, {"CPU": 2}],
+                                 strategy="STRICT_SPREAD")
+            assert pg.wait(timeout_seconds=60)
+            entry = placement_group_table()[pg.id.hex()]
+            assert len(set(entry["node_rows"])) == 3, entry
+            assert getattr(cluster.pg_manager, "device_batches", 0) >= 1
+            remove_placement_group(pg)
+            cluster.remove_node(n2)
+            cluster.remove_node(n3)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_locality_batch_on_device_lands_on_data(self):
+        """A device-scheduled batch with plasma args runs in the
+        data-holding node's workers (locality through the device path)."""
+        ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=2,
+                     system_config={"scheduler_device_batch_min": 1})
+        try:
+            from ray_tpu.api import _get_runtime
+            rt = _get_runtime()
+            cluster = rt.cluster
+            n2 = cluster.add_node(resources={"CPU": 4, "memory": 4},
+                                  num_workers=2)
+            blob = ray_tpu.put(bytes(300_000))
+            home = rt.raylet.row      # driver puts are born on the head
+
+            @ray_tpu.remote
+            def consume(b):
+                import os
+                return os.getpid()
+
+            pids = set(ray_tpu.get(
+                [consume.remote(blob) for _ in range(6)], timeout=90))
+            home_pool = cluster.raylets[home].pool
+            with home_pool._lock:
+                home_pids = {h.proc.pid for h in home_pool._workers}
+            assert pids <= home_pids, (pids, home_pids)
+            cluster.remove_node(n2)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_mixed_subgroups_match_host_twin(self):
+        """A class split across locality subgroups places IDENTICALLY
+        whether the round runs on device or through the host twin —
+        scheduler_device_batch_min stays unobservable."""
+        import numpy as np
+
+        from ray_tpu.ops.locality_kernel import \
+            schedule_grouped_localized_np
+        from ray_tpu.scheduling.cluster_resources import ClusterState
+        from ray_tpu.scheduling.policy import (CompositeSchedulingPolicy,
+                                               SchedulingOptions,
+                                               SchedulingType)
+
+        rng = np.random.default_rng(5)
+        N, R = 16, 3
+        totals = rng.integers(300, 1500, size=(N, R)).astype(np.int32)
+        avail = (totals * 0.7).astype(np.int32)
+        mask = np.ones(N, dtype=bool)
+        req = np.array([90, 30, 0], np.int32)
+        # one CLASS split into subgroups (no-pref, pref=4) — both
+        # backends process subgroups in first-appearance order
+        subs = [(req, 20, -1), (req, 15, 4)]
+        reqs = np.stack([s[0] for s in subs])
+        counts = np.array([s[1] for s in subs], np.int32)
+        prefs = np.array([s[2] for s in subs], np.int32)
+        dev, _ = schedule_grouped_localized_np(
+            totals, avail.copy(), mask, reqs, counts, prefs)
+
+        state = ClusterState(totals.copy(), avail.copy(), mask.copy())
+        policy = CompositeSchedulingPolicy()
+        host = np.zeros((2, N + 1), np.int64)
+        for g, (r, count, pref) in enumerate(subs):
+            for _ in range(count):
+                opts = SchedulingOptions(
+                    scheduling_type=SchedulingType.NODE_AFFINITY,
+                    node_row=int(pref), soft=True) if pref >= 0 \
+                    else SchedulingOptions()
+                row = policy.schedule(state, r, opts)
+                host[g, row if row >= 0 else N] += 1
+        assert (dev.astype(np.int64) == host).all(), (dev, host)
+
+    def test_topk_live_spreads_across_nodes(self):
+        """With top-k active, a device-scheduled burst spreads over
+        multiple nodes instead of packing one."""
+        ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=1,
+                     system_config={"scheduler_device_batch_min": 1,
+                                    "scheduler_top_k_fraction": 0.5,
+                                    "locality_aware_scheduling": False})
+        try:
+            from ray_tpu.api import _get_runtime
+            cluster = _get_runtime().cluster
+            added = [cluster.add_node(resources={"CPU": 4, "memory": 4},
+                                      num_workers=1) for _ in range(3)]
+
+            @ray_tpu.remote(num_cpus=1)
+            def where():
+                import os
+                import time
+                time.sleep(0.3)
+                return os.getpid()
+
+            pids = ray_tpu.get([where.remote() for _ in range(12)],
+                               timeout=120)
+            assert len(set(pids)) >= 2, "top-k burst packed one node"
+            for n in added:
+                cluster.remove_node(n)
+        finally:
+            ray_tpu.shutdown()
