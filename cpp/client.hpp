@@ -13,9 +13,11 @@
 //   auto ref = client.Call("add", {Value::Int(1), Value::Int(2)})[0];
 //   int64_t three = client.Get(ref).AsInt();
 //
-// Synchronous, one request in flight per client (guarded by a mutex);
-// open several clients for concurrency — each gateway connection serves
-// pipelined requests on server-side threads.
+// ASYNCHRONOUS like the reference C++ API: one connection multiplexes
+// any number of in-flight requests — a reader thread routes replies to
+// per-request promises, so `RpcAsync`/`CallAsync`/`GetAsync` return
+// `std::future`s that resolve as the gateway's server-side threads
+// finish.  The synchronous methods are `.get()` on those futures.
 
 #pragma once
 
@@ -24,10 +26,14 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdint>
+#include <future>
 #include <mutex>
 #include <stdexcept>
 #include <string>
+#include <thread>
+#include <unordered_map>
 #include <utility>
 #include <vector>
 
@@ -69,9 +75,13 @@ class Client {
     if (colon == std::string::npos)
       throw std::runtime_error("address must be host:port");
     Connect(address.substr(0, colon), address.substr(colon + 1));
+    reader_ = std::thread([this] { ReadLoop(); });
   }
 
   ~Client() {
+    closed_.store(true);
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);  // wakes the reader recv
+    if (reader_.joinable()) reader_.join();
     if (fd_ >= 0) ::close(fd_);
   }
 
@@ -79,22 +89,35 @@ class Client {
   Client& operator=(const Client&) = delete;
 
   // -- core RPC -----------------------------------------------------------
-  Value Rpc(const std::string& method, ValueList args) {
-    std::lock_guard<std::mutex> lock(mu_);
-    int64_t req_id = next_id_++;
+  // Asynchronous: the future resolves when the gateway replies; any
+  // number of requests pipeline on this one connection.
+  std::future<Value> RpcAsync(const std::string& method, ValueList args) {
+    std::future<Value> fut;
+    int64_t req_id;
+    {
+      std::lock_guard<std::mutex> lock(pending_mu_);
+      if (closed_.load())
+        throw std::runtime_error("client is closed");
+      req_id = next_id_++;
+      fut = pending_[req_id].get_future();
+    }
     Value request = Value::List(
         {Value::Int(req_id), Value::Str(method),
          Value::List(std::move(args))});
-    SendFrame(request.Encode());
-    // one request in flight under mu_, so the next reply is ours; check
-    // the id anyway — a mismatch means a protocol bug, not a stray frame
-    Value reply = Value::DecodeAll(RecvFrame());
-    const ValueList& parts = reply.AsList();
-    if (parts.size() != 3 || parts[0].AsInt() != req_id)
-      throw std::runtime_error("xlang: reply does not match request");
-    if (parts[1].AsBool()) return parts[2];
-    const ValueList& err = parts[2].AsList();
-    throw RemoteError(err.at(0).AsStr(), err.at(1).AsStr());
+    std::string payload = request.Encode();
+    try {
+      std::lock_guard<std::mutex> lock(send_mu_);
+      SendFrame(payload);
+    } catch (...) {
+      std::lock_guard<std::mutex> lock(pending_mu_);
+      pending_.erase(req_id);
+      throw;
+    }
+    return fut;
+  }
+
+  Value Rpc(const std::string& method, ValueList args) {
+    return RpcAsync(method, std::move(args)).get();
   }
 
   // -- object API ---------------------------------------------------------
@@ -114,6 +137,20 @@ class Client {
 
   Value Get(const ObjectRef& ref, double timeout_s = -1) {
     return Get(std::vector<ObjectRef>{ref}, timeout_s).at(0);
+  }
+
+  // resolves to the VALUE (unwrapped), matching the synchronous
+  // Get(ref); the unwrap runs deferred on the caller's .get()
+  std::future<Value> GetAsync(const ObjectRef& ref,
+                              double timeout_s = -1) {
+    auto raw = RpcAsync("get",
+                        {Value::List({Value::Bytes(ref.id)}),
+                         TimeoutValue(timeout_s)});
+    return std::async(std::launch::deferred,
+                      [f = std::move(raw)]() mutable {
+                        Value out = f.get();
+                        return out.AsList().at(0);
+                      });
   }
 
   std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
@@ -138,6 +175,14 @@ class Client {
                              Value::List(std::move(args)),
                              std::move(opts)});
     return RefList(out);
+  }
+
+  std::future<Value> CallAsync(const std::string& exported_name,
+                               ValueList args,
+                               Value opts = Value::Nil()) {
+    return RpcAsync("call", {Value::Str(exported_name),
+                             Value::List(std::move(args)),
+                             std::move(opts)});
   }
 
   // -- actor API ----------------------------------------------------------
@@ -211,6 +256,49 @@ class Client {
     fd_ = fd;
   }
 
+  void ReadLoop() {
+    // route every reply to its request's promise; connection loss
+    // fails all outstanding futures instead of hanging them
+    try {
+      while (!closed_.load()) {
+        Value reply = Value::DecodeAll(RecvFrame());
+        const ValueList& parts = reply.AsList();
+        if (parts.size() != 3)
+          throw std::runtime_error("xlang: malformed reply");
+        std::promise<Value> prom;
+        {
+          std::lock_guard<std::mutex> lock(pending_mu_);
+          auto it = pending_.find(parts[0].AsInt());
+          if (it == pending_.end())
+            // this client never abandons a request on a live
+            // connection, so an unknown id is a protocol bug — fail
+            // fast (the drain below fails every pending future)
+            // rather than dropping a reply someone is blocked on
+            throw std::runtime_error(
+                "xlang: reply for unknown request id");
+          prom = std::move(it->second);
+          pending_.erase(it);
+        }
+        if (parts[1].AsBool()) {
+          prom.set_value(parts[2]);
+        } else {
+          const ValueList& err = parts[2].AsList();
+          prom.set_exception(std::make_exception_ptr(
+              RemoteError(err.at(0).AsStr(), err.at(1).AsStr())));
+        }
+      }
+    } catch (...) {
+      // fall through to drain
+    }
+    std::lock_guard<std::mutex> lock(pending_mu_);
+    closed_.store(true);
+    for (auto& kv : pending_) {
+      kv.second.set_exception(std::make_exception_ptr(
+          std::runtime_error("connection lost")));
+    }
+    pending_.clear();
+  }
+
   void SendFrame(const std::string& payload) {
     // mirrors the server's MAX_FRAME sanity bound (rpc/wire.py); also
     // rules out u32 length truncation for >4 GiB payloads — a wrapped
@@ -257,7 +345,11 @@ class Client {
   }
 
   int fd_ = -1;
-  std::mutex mu_;
+  std::mutex send_mu_;
+  std::mutex pending_mu_;
+  std::unordered_map<int64_t, std::promise<Value>> pending_;
+  std::thread reader_;
+  std::atomic<bool> closed_{false};
   int64_t next_id_ = 0;
 };
 

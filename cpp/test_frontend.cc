@@ -145,6 +145,32 @@ static void TestIntrospection(Client& client) {
   CHECK(!resources.AsMap().empty());
 }
 
+static void TestAsyncPipelining(Client& client) {
+  // many requests in flight on ONE connection: futures resolve as the
+  // gateway's server-side threads finish (the async frontend surface)
+  std::vector<std::future<Value>> futs;
+  for (int i = 0; i < 8; ++i)
+    futs.push_back(client.CallAsync(
+        "xadd", {Value::Int(i), Value::Int(100)}));
+  std::vector<ObjectRef> refs;
+  for (auto& f : futs) refs.push_back(ObjectRef{f.get().AsList().at(0).AsBytes()});
+  std::vector<std::future<Value>> gets;
+  for (auto& r : refs) gets.push_back(client.GetAsync(r, 30));
+  for (int i = 0; i < 8; ++i) {
+    // GetAsync unwraps like the synchronous Get(ref)
+    CHECK(gets[i].get().AsInt() == i + 100);
+  }
+  // async errors surface through the future
+  auto bad = client.RpcAsync("no_such_method", {});
+  bool threw = false;
+  try {
+    bad.get();
+  } catch (const RemoteError&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
 int main(int argc, char** argv) {
   if (argc != 2) {
     std::fprintf(stderr, "usage: %s host:port\n", argv[0]);
@@ -159,6 +185,7 @@ int main(int argc, char** argv) {
     TestErrors(client);
     TestWait(client);
     TestActors(client);
+    TestAsyncPipelining(client);
   } catch (const std::exception& e) {
     std::fprintf(stderr, "unexpected exception: %s\n", e.what());
     return 1;

@@ -2,8 +2,16 @@
 
 The rollout plane mirrors the reference (worker actors step envs with
 policy weights broadcast each iteration, samples return through the
-object store); the learner is a single jitted update over the batched
-episodes (SURVEY.md §1 layer 14; mount empty).
+object store).  The learner plane scales: ``num_learners`` > 1 runs a
+gang of gradient-synchronized learner actors — each computes SUM
+gradients on its shard of the batch, allreduces them through the
+collective process group (``ray_tpu.util.collective``), and applies the
+identical averaged update, so every learner holds the same params
+(upstream's multi-learner + NCCL allreduce shape — SURVEY.md §1 layer
+14; mount empty).  The multi-learner update is numerically equivalent
+to the single-learner one (global baseline computed driver-side, SUM
+gradients divided by the global count), not bitwise: float reduction
+order differs.
 """
 
 from __future__ import annotations
@@ -17,6 +25,27 @@ import numpy as np
 def _softmax_logits(params, obs):
     import jax.numpy as jnp
     return obs @ params["w"] + params["b"]
+
+
+def _init_params(obs_dim: int, num_actions: int, seed: int) -> dict:
+    """THE policy init — driver and every learner call this, so the
+    gang and the rollout broadcast can never diverge by a drifted copy
+    of the init (scale/dtype/rng order)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "w": (0.01 * rng.normal(size=(obs_dim, num_actions))
+              ).astype(np.float32),
+        "b": np.zeros(num_actions, dtype=np.float32)}
+
+
+def _chosen_logp(params, obs, actions):
+    """log pi(a|s) for the taken actions — shared by the single-learner
+    objective and the learner gang's gradient."""
+    import jax
+    import jax.numpy as jnp
+    logits = _softmax_logits(params, obs)
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
 
 
 def _sample_action(params, obs, rng: np.random.Generator) -> int:
@@ -70,7 +99,61 @@ class PGConfig:
     gamma: float = 0.99
     lr: float = 0.05
     seed: int = 0
+    # > 1: gradient-synchronized learner gang (collective allreduce)
+    num_learners: int = 1
     extra: dict = field(default_factory=dict)
+
+
+class LearnerWorker:
+    """One of N gradient-synchronized learners: SUM gradients on its
+    shard, allreduce across the gang, identical averaged update."""
+
+    def __init__(self, obs_dim: int, num_actions: int, lr: float,
+                 seed: int, rank: int, world: int, group: str):
+        import jax
+        self._params = _init_params(obs_dim, num_actions, seed)
+        self._lr = lr
+        self._world = world
+        self._group = group
+        if world > 1:
+            from ..util.collective import init_collective_group
+            init_collective_group(world, rank, group_name=group)
+
+        def grad_sum(params, obs, actions, adv):
+            def neg_objective(p):
+                return -(_chosen_logp(p, obs, actions) * adv).sum()
+            return jax.grad(neg_objective)(params)
+
+        self._grad_sum = jax.jit(grad_sum)
+
+    def update_shard(self, obs, actions, adv, global_count: int) -> int:
+        """Gradient on THIS shard, allreduced, applied; returns the
+        global count for sanity.  Empty shards contribute zeros (every
+        rank must join the allreduce)."""
+        if len(adv):
+            grads = self._grad_sum(self._params,
+                                   np.asarray(obs, np.float32),
+                                   np.asarray(actions, np.int32),
+                                   np.asarray(adv, np.float32))
+            gw = np.asarray(grads["w"])
+            gb = np.asarray(grads["b"])
+        else:
+            gw = np.zeros_like(self._params["w"])
+            gb = np.zeros_like(self._params["b"])
+        flat = np.concatenate([gw.ravel(), gb.ravel()])
+        if self._world > 1:
+            from ..util.collective import allreduce
+            flat = np.asarray(allreduce(flat, group_name=self._group))
+        flat /= max(global_count, 1)
+        k = self._params["w"].size
+        self._params = {
+            "w": self._params["w"] - self._lr
+            * flat[:k].reshape(self._params["w"].shape),
+            "b": self._params["b"] - self._lr * flat[k:]}
+        return int(global_count)
+
+    def params(self) -> dict:
+        return {k: np.asarray(v) for k, v in self._params.items()}
 
 
 class Algorithm:
@@ -83,17 +166,24 @@ class Algorithm:
             raise ValueError(
                 "PGConfig needs env_creator, obs_dim, num_actions")
         self.config = config
-        rng = np.random.default_rng(config.seed)
-        self._params = {
-            "w": (0.01 * rng.normal(size=(config.obs_dim,
-                                          config.num_actions))
-                  ).astype(np.float32),
-            "b": np.zeros(config.num_actions, dtype=np.float32)}
+        self._params = _init_params(config.obs_dim, config.num_actions,
+                                    config.seed)
         worker_cls = ray_tpu.remote(RolloutWorker)
         env_bytes = serialize(config.env_creator)
         self._workers = [worker_cls.remote(env_bytes, config.seed + i)
                          for i in range(config.num_workers)]
         self._update = jax.jit(self._make_update())
+        self._learners: list = []
+        if getattr(config, "num_learners", 1) > 1:
+            import os
+            learner_cls = ray_tpu.remote(LearnerWorker)
+            group = f"rllib-learners-{os.urandom(4).hex()}"
+            world = config.num_learners
+            self._learners = [
+                learner_cls.remote(config.obs_dim, config.num_actions,
+                                   config.lr, config.seed, rank, world,
+                                   group)
+                for rank in range(world)]
         self.iteration = 0
 
     def _make_update(self):
@@ -103,10 +193,7 @@ class Algorithm:
 
         def update(params, obs, actions, returns, mask):
             def neg_objective(p):
-                logits = _softmax_logits(p, obs)       # (T, A)
-                logp = jax.nn.log_softmax(logits)
-                chosen = jnp.take_along_axis(
-                    logp, actions[:, None], axis=1)[:, 0]
+                chosen = _chosen_logp(p, obs, actions)
                 # advantage = return - batch baseline (variance cut)
                 denom = jnp.maximum(mask.sum(), 1.0)
                 baseline = (returns * mask).sum() / denom
@@ -161,9 +248,35 @@ class Algorithm:
         obs = np.concatenate(obs)
         acts = np.concatenate(acts)
         rets = np.concatenate(rets).astype(np.float32)
-        mask = np.ones(len(rets), dtype=np.float32)
-        self._params = self._update(self._params, obs, acts, rets, mask)
+        if self._learners:
+            self._train_multi_learner(obs, acts, rets)
+        else:
+            mask = np.ones(len(rets), dtype=np.float32)
+            self._params = self._update(self._params, obs, acts, rets,
+                                        mask)
         return self._iter_metrics(episodes, ep_rewards, len(rets))
+
+    def _train_multi_learner(self, obs, acts, rets) -> None:
+        """Shard the batch across the learner gang; each computes SUM
+        gradients, allreduces, applies the identical update.  The
+        baseline is GLOBAL (computed here) so the summed shard
+        gradients equal the single-learner batch gradient."""
+        import ray_tpu
+        adv = (rets - rets.mean()).astype(np.float32)
+        n = len(adv)
+        world = len(self._learners)
+        bounds = [round(i * n / world) for i in range(world + 1)]
+        refs = [
+            learner.update_shard.remote(
+                obs[bounds[r]:bounds[r + 1]],
+                acts[bounds[r]:bounds[r + 1]],
+                adv[bounds[r]:bounds[r + 1]], n)
+            for r, learner in enumerate(self._learners)]
+        ray_tpu.get(refs, timeout=300)
+        # every learner holds identical params; mirror rank 0's for the
+        # rollout broadcast
+        self._params = ray_tpu.get(self._learners[0].params.remote(),
+                                   timeout=60)
 
     def get_policy_params(self) -> dict:
         return {k: np.asarray(v) for k, v in self._params.items()}
@@ -180,6 +293,9 @@ class Algorithm:
         for w in self._workers:
             ray_tpu.kill(w)
         self._workers = []
+        for ln in getattr(self, "_learners", []):
+            ray_tpu.kill(ln)
+        self._learners = []
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +330,10 @@ class PPO(Algorithm):
     step per minibatch (reference ``rllib/algorithms/ppo``)."""
 
     def __init__(self, config: PPOConfig):
+        if getattr(config, "num_learners", 1) > 1:
+            raise ValueError(
+                "num_learners > 1 is implemented for the policy-"
+                "gradient Algorithm; PPO runs a single learner")
         super().__init__(config)
         self._params = dict(self._params)
         self._params["vw"] = np.zeros(config.obs_dim, dtype=np.float32)

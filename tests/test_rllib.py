@@ -48,6 +48,58 @@ class Corridor:
         return self._obs(), -0.05, False
 
 
+class TestMultiLearner:
+    """num_learners > 1: a gradient-synchronized learner gang (SUM
+    gradients allreduced over the collective group, identical updates)."""
+
+    def _config(self, num_learners, seed=7):
+        return PGConfig(env_creator=TwoArmBandit, obs_dim=1,
+                        num_actions=2, num_workers=2,
+                        episodes_per_worker=6, horizon=1, lr=0.2,
+                        seed=seed, num_learners=num_learners)
+
+    def test_learners_stay_identical_and_match_single(self):
+        """After an iteration every learner holds the SAME params, and
+        they match the single-learner update on the same episodes
+        (numerically — reduction order differs)."""
+        single = Algorithm(self._config(1))
+        multi = Algorithm(self._config(3))
+        try:
+            single.train()
+            multi.train()
+            p1 = single.get_policy_params()
+            pm = multi.get_policy_params()
+            for k in ("w", "b"):
+                np.testing.assert_allclose(pm[k], p1[k], rtol=1e-4,
+                                           atol=1e-5)
+            # the gang agrees with itself exactly
+            all_params = ray_tpu.get(
+                [ln.params.remote() for ln in multi._learners],
+                timeout=60)
+            for p in all_params[1:]:
+                for k in ("w", "b"):
+                    np.testing.assert_array_equal(p[k],
+                                                  all_params[0][k])
+        finally:
+            single.stop()
+            multi.stop()
+
+    def test_multi_learner_learns(self):
+        algo = Algorithm(self._config(2, seed=3))
+        try:
+            for _ in range(25):
+                metrics = algo.train()
+            assert metrics["episode_reward_mean"] > 0.8, metrics
+        finally:
+            algo.stop()
+
+    def test_ppo_rejects_multi_learner(self):
+        from ray_tpu.rllib import PPO, PPOConfig
+        with pytest.raises(ValueError, match="single learner"):
+            PPO(PPOConfig(env_creator=TwoArmBandit, obs_dim=1,
+                          num_actions=2, num_learners=2))
+
+
 class TestPolicyGradient:
     def test_bandit_learns_best_arm(self):
         algo = Algorithm(PGConfig(
