@@ -48,18 +48,26 @@ class ActorMethod:
         job_id = actor_id.job_id()
         task_id = TaskID.for_task(job_id, actor_id)
         trace_ctx = context_for_new_task(task_id)
+        # "streaming": the method is a generator; items seal
+        # incrementally and the caller gets an ObjectRefGenerator
+        # (reference: streaming actor calls share the generator protocol)
+        num_returns = -1 if self._num_returns == "streaming" \
+            else self._num_returns
         if rt.is_driver:
             rt.actor_manager.submit(actor_id, task_id, self._name, args,
-                                    kwargs, self._num_returns,
+                                    kwargs, num_returns,
                                     trace_ctx=trace_ctx,
                                     concurrency_group=self._group)
         else:
             rt.submit_actor_call(actor_id, task_id, self._name, args,
-                                 kwargs, self._num_returns, trace_ctx,
+                                 kwargs, num_returns, trace_ctx,
                                  concurrency_group=self._group)
+        if num_returns == -1:
+            from .runtime.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(task_id, rt)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i + 1))
-                for i in range(self._num_returns)]
-        return refs[0] if self._num_returns == 1 else refs
+                for i in range(num_returns)]
+        return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -167,7 +175,8 @@ class ActorClass:
             # worker-side detection alone would cap effective
             # concurrency at the default window
             import inspect
-            if any(inspect.iscoroutinefunction(m) for _n, m in
+            if any(inspect.iscoroutinefunction(m)
+                   or inspect.isasyncgenfunction(m) for _n, m in
                    inspect.getmembers(self._cls) if callable(m)):
                 concurrency = {"max_concurrency": 1000,
                                "concurrency_groups": None}

@@ -336,7 +336,11 @@ class Cluster:
 
     def stream_ack(self, task_id, consumed: int) -> None:
         """Route a streaming-generator consumption ack to whichever
-        raylet is running the task (best-effort)."""
+        worker runs the producer — a task's raylet or a streaming actor
+        call's dedicated worker (best-effort)."""
+        if self.actor_manager is not None and \
+                self.actor_manager.stream_ack(task_id, consumed):
+            return
         with self._lock:
             raylets = list(self.raylets.values())
         for r in raylets:
@@ -348,11 +352,14 @@ class Cluster:
         cooperatively (it stops yielding at its next backpressure
         check) and reclaim sealed-but-unconsumed items everywhere."""
         orphans = self.task_manager.stream_close(task_id, consumed)
-        with self._lock:
-            raylets = list(self.raylets.values())
-        for r in raylets:
-            if r.stream_cancel(task_id):
-                break
+        cancelled = (self.actor_manager is not None
+                     and self.actor_manager.stream_cancel(task_id))
+        if not cancelled:
+            with self._lock:
+                raylets = list(self.raylets.values())
+            for r in raylets:
+                if r.stream_cancel(task_id):
+                    break
         for oid in orphans:
             if self.store.contains(oid):
                 self._reclaim_object(oid)

@@ -99,6 +99,9 @@ class ActorManager:
         self._actors: dict[ActorID, ActorRecord] = {}
         # (namespace, name) -> actor id
         self._names: dict[tuple[str, str], ActorID] = {}
+        # streaming actor calls in flight: call task id -> actor id
+        # (routes consumer acks/cancels to the actor's worker)
+        self._stream_calls: dict[bytes, ActorID] = {}
 
     # -- creation -----------------------------------------------------------
     def create_actor(self, actor_id: ActorID, cls_id: str,
@@ -289,6 +292,10 @@ class ActorManager:
                args: tuple, kwargs: dict, num_returns: int,
                trace_ctx: tuple | None = None,
                concurrency_group: str | None = None) -> None:
+        if num_returns == -1:
+            # streaming call: the table entry makes consumer waits
+            # meaningful from submission, before any item seals
+            self._cluster.task_manager.stream_open(task_id)
         with self._lock:
             rec = self._actors.get(actor_id)
             if rec is None or rec.state is ActorState.DEAD:
@@ -298,8 +305,32 @@ class ActorManager:
                              retries_left=rec.max_task_retries,
                              trace_ctx=trace_ctx,
                              group=concurrency_group)
+            if num_returns == -1:
+                self._stream_calls[task_id.binary()] = actor_id
             rec.queue.append(call)
         self._pump(actor_id)
+
+    def stream_ack(self, task_id: TaskID, consumed: int) -> bool:
+        """Relay a consumer ack to the worker running a streaming actor
+        call (False when unknown — e.g. already finished)."""
+        return self._stream_forward(task_id,
+                                    ("stream_ack", task_id.binary(),
+                                     consumed))
+
+    def stream_cancel(self, task_id: TaskID) -> bool:
+        return self._stream_forward(task_id,
+                                    ("stream_cancel",
+                                     task_id.binary()))
+
+    def _stream_forward(self, task_id: TaskID, frame: tuple) -> bool:
+        with self._lock:
+            actor_id = self._stream_calls.get(task_id.binary())
+            rec = self._actors.get(actor_id) if actor_id else None
+            worker = rec.worker if rec is not None else None
+        if worker is None:
+            return False
+        worker.send(frame)
+        return True
 
     @staticmethod
     def _window(rec: ActorRecord) -> int:
@@ -317,8 +348,21 @@ class ActorManager:
         err = RayTaskError(
             "actor task", "actor is dead",
             ActorDiedError(f"actor {actor_id.hex()[:12]} is dead"))
+        self._seal_call_error(task_id, num_returns, err)
+
+    def _seal_call_error(self, task_id: TaskID, num_returns: int,
+                         err) -> None:
+        """Fail one call's outputs: fixed returns seal the error;
+        streaming calls finish their stream with it (waking blocked
+        consumers) and drop the ack-routing entry."""
+        if num_returns == -1:
+            self._cluster.task_manager.stream_finished(task_id, err)
+            with self._lock:
+                self._stream_calls.pop(task_id.binary(), None)
+            return
         for i in range(num_returns):
-            self._store.put(ObjectID.for_task_return(task_id, i + 1), err)
+            self._store.put(ObjectID.for_task_return(task_id, i + 1),
+                            err)
 
     def _pump(self, actor_id: ActorID) -> None:
         """Send queued calls in order while deps-ready and window open.
@@ -365,10 +409,7 @@ class ActorManager:
                     else:
                         vals.append(a)
                 if dep_err is not None:
-                    for i in range(call.num_returns):
-                        self._store.put(
-                            ObjectID.for_task_return(call.task_id, i + 1),
-                            dep_err)
+                    self._seal_call_error(call.task_id, call.num_returns, dep_err)
                     continue
                 rec.inflight[call.task_id.binary()] = call
                 import time as _time
@@ -431,6 +472,7 @@ class ActorManager:
             with self._lock:
                 rec = self._actors.get(actor_id) if actor_id else None
                 call = rec.inflight.pop(task_id_bin, None) if rec else None
+                self._stream_calls.pop(task_id_bin, None)
             if call is None:
                 return True
             if call.trace_ctx is not None:
@@ -479,9 +521,7 @@ class ActorManager:
                         self._cluster.seal_serialized(oid, d[1], head_row)
             else:
                 err = deserialize(msg[2])
-                for i in range(call.num_returns):
-                    self._store.put(
-                        ObjectID.for_task_return(call.task_id, i + 1), err)
+                self._seal_call_error(call.task_id, call.num_returns, err)
             if actor_id:
                 self._pump(actor_id)
             return True
@@ -533,9 +573,7 @@ class ActorManager:
                     call.retries_left -= 1
                 retried.append(call)
             else:
-                for i in range(call.num_returns):
-                    self._store.put(
-                        ObjectID.for_task_return(call.task_id, i + 1), err)
+                self._seal_call_error(call.task_id, call.num_returns, err)
         if can_restart:
             with self._lock:
                 for call in reversed(retried):
@@ -544,9 +582,7 @@ class ActorManager:
                                lambda: self._restart_incarnation(rec))
         else:
             for call in (queued or []):
-                for i in range(call.num_returns):
-                    self._store.put(
-                        ObjectID.for_task_return(call.task_id, i + 1), err)
+                self._seal_call_error(call.task_id, call.num_returns, err)
         return True
 
     def _restart_incarnation(self, rec: ActorRecord) -> None:
@@ -594,9 +630,7 @@ class ActorManager:
         err = init_error if init_error is not None else RayTaskError(
             "actor ctor", "actor failed to start", ActorDiedError())
         for call in queued:
-            for i in range(call.num_returns):
-                self._store.put(
-                    ObjectID.for_task_return(call.task_id, i + 1), err)
+            self._seal_call_error(call.task_id, call.num_returns, err)
 
     # -- kill / lookup ------------------------------------------------------
     def kill(self, actor_id: ActorID, no_restart: bool = True) -> None:
@@ -632,9 +666,7 @@ class ActorManager:
             "actor task", "actor was killed",
             ActorDiedError(f"actor {rec.actor_id.hex()[:12]} was killed"))
         for call in queued:
-            for i in range(call.num_returns):
-                self._store.put(
-                    ObjectID.for_task_return(call.task_id, i + 1), err)
+            self._seal_call_error(call.task_id, call.num_returns, err)
 
     def fail_actors_on_pool(self, pool) -> None:
         """Node removal: every actor placed on this pool loses its worker.

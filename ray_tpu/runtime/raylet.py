@@ -1517,9 +1517,9 @@ class Raylet:
             from ..common.ids import ObjectID as _OID
             tid = TaskID(msg[1])
             oid = _OID.for_task_return(tid, msg[2])
-            rec = self.task_manager.get(tid)
-            if rec is not None and oid not in rec.dead_returns \
-                    and not rec.stream_closed:
+            rec = self.task_manager.get(tid)   # None for actor streams
+            if self.task_manager.stream_accepts(tid) and \
+                    (rec is None or oid not in rec.dead_returns):
                 self._register_contained(oid, msg[4])
                 self.cluster.seal_serialized(oid, msg[3], self.row)
                 self.task_manager.stream_item_sealed(tid, msg[2])
@@ -1532,8 +1532,9 @@ class Raylet:
             oid = _OID.for_task_return(tid, msg[2])
             rec = self.task_manager.get(tid)
             d = msg[3]
-            if rec is None or oid in rec.dead_returns \
-                    or rec.stream_closed or d[0] != "p":
+            if not self.task_manager.stream_accepts(tid) \
+                    or (rec is not None and oid in rec.dead_returns) \
+                    or d[0] != "p":
                 # dropped item: the agent's arena copy is orphaned —
                 # free it (mirrors _seal_results_x's dead-return path)
                 if d[0] == "p" and self.plane_address is not None:

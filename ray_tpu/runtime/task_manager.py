@@ -29,18 +29,26 @@ class TaskRecord:
     recovering: bool = False        # a reconstruction resubmit is in flight
     lineage_bytes: int = 0          # retained-spec cost while done
     dead_returns: set = field(default_factory=set)
-    # streaming-generator state (spec.num_returns == -1): highest sealed
-    # item index, whether the generator finished, and its error if any
-    stream_sealed: int = 0
-    stream_done: bool = False
-    stream_error: object = None
-    stream_closed: bool = False     # consumer finished/abandoned it
+
+
+@dataclass
+class StreamState:
+    """Streaming-generator progress for one producing task or actor
+    call (spec/call ``num_returns == -1``): highest sealed item index,
+    whether the producer finished (and its error), and whether the
+    consumer closed the stream.  Lives in its OWN table so actor calls
+    (which have no TaskRecord) stream through the same machinery."""
+    sealed: int = 0
+    done: bool = False
+    error: object = None
+    closed: bool = False
 
 
 class TaskManager:
     def __init__(self):
         self._lock = threading.Lock()
         self._stream_cv = threading.Condition()     # stream progress
+        self._streams: dict[TaskID, StreamState] = {}
         self._records: dict[TaskID, TaskRecord] = {}
         # completed records in retention order (lineage eviction is FIFO:
         # oldest finished task loses reconstructability first)
@@ -58,6 +66,8 @@ class TaskManager:
         rec = TaskRecord(spec, spec.max_retries, return_ids)
         with self._lock:
             self._records[spec.task_id] = rec
+        if spec.num_returns == -1:
+            self.stream_open(spec.task_id)
         return rec
 
     def list_rows(self) -> list[dict]:
@@ -119,7 +129,8 @@ class TaskManager:
         skipped = []
         while self._lineage_bytes > self._budget and self._done:
             tid, rec = self._done.popitem(last=False)
-            if rec.spec.num_returns == -1 and not rec.stream_closed:
+            if rec.spec.num_returns == -1 and \
+                    self.stream_accepts(tid):
                 skipped.append((tid, rec))
                 continue
             self._lineage_bytes -= rec.lineage_bytes
@@ -180,64 +191,80 @@ class TaskManager:
             return True
 
     # -- streaming generators -----------------------------------------------
-    def stream_item_sealed(self, task_id: TaskID, index: int) -> None:
-        """Item ``index`` (1-based) of a generator task sealed.  Uses
-        max() so a retrying re-execution's re-seals are idempotent."""
+    def stream_open(self, task_id: TaskID) -> None:
+        """Register a stream at submission time: a consumer's wait on a
+        never-opened (or fully finished+closed) stream reads as ended."""
         with self._stream_cv:
-            rec = self._records.get(task_id)
-            if rec is not None:
-                rec.stream_sealed = max(rec.stream_sealed, index)
+            self._streams.setdefault(task_id, StreamState())
+
+    def stream_accepts(self, task_id: TaskID) -> bool:
+        """May a produced item still seal?  False once the consumer
+        closed the stream (or it was never opened / already reaped)."""
+        with self._stream_cv:
+            st = self._streams.get(task_id)
+            return st is not None and not st.closed
+
+    def stream_item_sealed(self, task_id: TaskID, index: int) -> None:
+        """Item ``index`` (1-based) sealed.  Uses max() so a retrying
+        re-execution's re-seals are idempotent."""
+        with self._stream_cv:
+            st = self._streams.get(task_id)
+            if st is not None:
+                st.sealed = max(st.sealed, index)
             self._stream_cv.notify_all()
 
     def stream_finished(self, task_id: TaskID, error=None) -> None:
         with self._stream_cv:
-            rec = self._records.get(task_id)
-            if rec is not None:
-                rec.stream_done = True
-                if error is not None and rec.stream_error is None:
-                    rec.stream_error = error
+            st = self._streams.get(task_id)
+            if st is not None:
+                st.done = True
+                if error is not None and st.error is None:
+                    st.error = error
+                if st.closed:
+                    del self._streams[task_id]  # both sides finished
             self._stream_cv.notify_all()
 
     def wait_stream(self, task_id: TaskID, index: int,
                     timeout: float | None = None):
         """Block until item ``index+1`` exists or the stream finished.
         Returns (sealed, done, error); (0, True, None) for an unknown
-        record (evicted => treat as ended)."""
+        stream (never opened, or reaped => treat as ended)."""
         import time
         deadline = None if timeout is None else \
             time.monotonic() + timeout
         with self._stream_cv:
             while True:
-                rec = self._records.get(task_id)
-                if rec is None:
+                st = self._streams.get(task_id)
+                if st is None:
                     return 0, True, None
-                if rec.stream_sealed > index or rec.stream_done:
-                    return (rec.stream_sealed, rec.stream_done,
-                            rec.stream_error)
+                if st.sealed > index or st.done:
+                    return st.sealed, st.done, st.error
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return (rec.stream_sealed, rec.stream_done,
-                                rec.stream_error)
+                        return st.sealed, st.done, st.error
                     self._stream_cv.wait(remaining)
                 else:
                     self._stream_cv.wait()
 
     def stream_close(self, task_id: TaskID, consumed: int) -> list:
         """The consumer is done with a stream (exhausted it or abandoned
-        it): unpin the record for lineage eviction and return the ids of
-        sealed-but-unconsumed items for the caller to reclaim.  Those
-        ids also become dead returns so a producer retry cannot re-seal
-        them."""
+        it): unpin lineage eviction and return the ids of sealed-but-
+        unconsumed items for the caller to reclaim.  Those ids also
+        become dead returns (when a task record exists) so a producer
+        retry cannot re-seal them."""
         with self._stream_cv:
-            rec = self._records.get(task_id)
-            if rec is None:
+            st = self._streams.get(task_id)
+            if st is None:
                 return []
-            rec.stream_closed = True
+            st.closed = True
             orphans = [ObjectID.for_task_return(task_id, i)
-                       for i in range(consumed + 1,
-                                      rec.stream_sealed + 1)]
-            rec.dead_returns.update(orphans)
+                       for i in range(consumed + 1, st.sealed + 1)]
+            rec = self._records.get(task_id)
+            if rec is not None:
+                rec.dead_returns.update(orphans)
+            if st.done:
+                del self._streams[task_id]      # both sides finished
             self._stream_cv.notify_all()
         return orphans
 

@@ -149,8 +149,12 @@ class WorkerApiContext:
                         self._stream_cv.notify_all()
             elif msg[0] == "stream_cancel":
                 with self._stream_cv:
-                    self._stream_cancelled.add(msg[1])
-                    self._stream_cv.notify_all()
+                    # ACTIVE streams only (like stream_ack): a cancel
+                    # racing past stream_done must not park a dead
+                    # entry in the set forever
+                    if msg[1] in self._stream_active:
+                        self._stream_cancelled.add(msg[1])
+                        self._stream_cv.notify_all()
             else:
                 work_q.put(msg)
         work_q.put(None)
@@ -391,6 +395,7 @@ class _ActorExecutor:
         conc = concurrency or {}
         self._is_async = any(
             inspect.iscoroutinefunction(m)
+            or inspect.isasyncgenfunction(m)
             for _n, m in inspect.getmembers(type(instance))
             if callable(m))
         default = 1000 if self._is_async else 1
@@ -506,6 +511,33 @@ class _CallScope:
         return True         # error already shipped as a frame
 
 
+def _stream_results(ctx: WorkerApiContext, task_id_bin: bytes, out,
+                    result_kind: str) -> None:
+    """Drive a generator's items through the streaming protocol: each
+    yield seals incrementally, the consumer's acks slide the
+    backpressure window, and the terminal result frame (``result`` for
+    tasks, ``actor_result`` for actor calls) closes the bookkeeping."""
+    from ..common.config import get_config
+    from .object_ref import serialize_collecting
+    window = max(get_config().streaming_backpressure_items, 1)
+    ctx.stream_begin(task_id_bin)
+    idx = 0
+    try:
+        for item in out:
+            idx += 1
+            data, inner = serialize_collecting(item)
+            ctx.send(("stream_item", task_id_bin, idx, data, inner))
+            item = data = inner = None
+            if not ctx.stream_wait_budget(task_id_bin, idx, window):
+                break   # consumer closed the stream
+    finally:
+        if hasattr(out, "close"):
+            out.close()     # GeneratorExit into user code
+        ctx.stream_done(task_id_bin)
+    ctx.send(("stream_end", task_id_bin, idx))
+    ctx.send((result_kind, task_id_bin, [], []))
+
+
 def _run_actor_call(ctx: WorkerApiContext, executor: _ActorExecutor,
                     task_id_bin: bytes, method: str, args, kwargs,
                     num_returns: int, trace_ctx) -> None:
@@ -515,7 +547,11 @@ def _run_actor_call(ctx: WorkerApiContext, executor: _ActorExecutor,
         out = getattr(executor.instance, method)(*args, **kwargs)
         if hasattr(out, "__await__"):
             raise RuntimeError("coroutine escaped the async path")
-        _send_call_results(ctx, task_id_bin, method, out, num_returns)
+        if num_returns == -1:
+            _stream_results(ctx, task_id_bin, out, "actor_result")
+        else:
+            _send_call_results(ctx, task_id_bin, method, out,
+                               num_returns)
 
 
 async def _run_actor_call_async(ctx, executor, task_id_bin, method,
@@ -525,7 +561,52 @@ async def _run_actor_call_async(ctx, executor, task_id_bin, method,
         out = getattr(executor.instance, method)(*args, **kwargs)
         if hasattr(out, "__await__"):
             out = await out
-        _send_call_results(ctx, task_id_bin, method, out, num_returns)
+        if num_returns == -1:
+            if hasattr(out, "__aiter__"):
+                # async generator: collect through the same protocol
+                # with awaited iteration
+                await _stream_results_async(ctx, task_id_bin, out)
+            else:
+                # sync generator on the LOOP thread: its backpressure
+                # waits block — run it on the executor so concurrent
+                # async calls keep serving
+                import asyncio
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _stream_results, ctx, task_id_bin, out,
+                    "actor_result")
+        else:
+            _send_call_results(ctx, task_id_bin, method, out,
+                               num_returns)
+
+
+async def _stream_results_async(ctx, task_id_bin: bytes, out) -> None:
+    import asyncio
+
+    from ..common.config import get_config
+    from .object_ref import serialize_collecting
+    window = max(get_config().streaming_backpressure_items, 1)
+    loop = asyncio.get_running_loop()
+    ctx.stream_begin(task_id_bin)
+    idx = 0
+    try:
+        async for item in out:
+            idx += 1
+            data, inner = serialize_collecting(item)
+            ctx.send(("stream_item", task_id_bin, idx, data, inner))
+            item = data = inner = None
+            # backpressure wait off the loop thread (it blocks)
+            ok = await loop.run_in_executor(
+                None, ctx.stream_wait_budget, task_id_bin, idx, window)
+            if not ok:
+                break
+    finally:
+        try:
+            await out.aclose()      # user finally/cleanup runs NOW,
+        except Exception:           # not at GC finalization
+            pass
+        ctx.stream_done(task_id_bin)
+    ctx.send(("stream_end", task_id_bin, idx))
+    ctx.send(("actor_result", task_id_bin, [], []))
 
 
 def _send_call_results(ctx, task_id_bin, method, out,
@@ -609,30 +690,9 @@ def worker_main(conn, worker_index: int,
                 if num_returns == -1:
                     # streaming generator: each yielded item seals
                     # incrementally; the consumer's acks drive
-                    # backpressure so at most ``window`` unconsumed
-                    # items exist at once (reference: streaming
-                    # generator protocol, num_returns="streaming")
-                    from ..common.config import get_config
-                    window = max(
-                        get_config().streaming_backpressure_items, 1)
-                    ctx.stream_begin(task_id_bin)
-                    idx = 0
-                    try:
-                        for item in out:
-                            idx += 1
-                            data, inner = serialize_collecting(item)
-                            ctx.send(("stream_item", task_id_bin, idx,
-                                      data, inner))
-                            item = data = inner = None
-                            if not ctx.stream_wait_budget(
-                                    task_id_bin, idx, window):
-                                break   # consumer closed the stream
-                    finally:
-                        if hasattr(out, "close"):
-                            out.close()     # GeneratorExit into user code
-                        ctx.stream_done(task_id_bin)
-                    ctx.send(("stream_end", task_id_bin, idx))
-                    ctx.send(("result", task_id_bin, [], []))
+                    # backpressure (reference: streaming generator
+                    # protocol, num_returns="streaming")
+                    _stream_results(ctx, task_id_bin, out, "result")
                 else:
                     if num_returns == 1:
                         results = [out]

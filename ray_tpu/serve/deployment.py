@@ -46,11 +46,30 @@ class _ReplicaShell:
         self._kv_key = kv_key.encode()
 
     def __serve_call__(self, method: str, args: tuple, kwargs: dict):
+        import inspect
+
         from ray_tpu.experimental.internal_kv import _internal_kv_incr
-        try:
-            return getattr(self._obj, method)(*args, **kwargs)
-        finally:
+
+        def settle():
             _internal_kv_incr(self._kv_key, -1, namespace="serve")
+        try:
+            out = getattr(self._obj, method)(*args, **kwargs)
+        except BaseException:
+            settle()
+            raise
+        if inspect.isgenerator(out):
+            # a STREAMING response stays in the inflight count until
+            # the stream finishes — calling the generator function
+            # returns instantly, and settling then would leave the
+            # autoscaler blind to long-running streams
+            def stream():
+                try:
+                    yield from out
+                finally:
+                    settle()
+            return stream()
+        settle()
+        return out
 
 
 # -- controller actor --------------------------------------------------------
@@ -171,9 +190,11 @@ class DeploymentHandle:
     ``bind``.
     """
 
-    def __init__(self, controller_handle, method: str = "__call__"):
+    def __init__(self, controller_handle, method: str = "__call__",
+                 stream: bool = False):
         self._controller = controller_handle
         self._method = method
+        self._stream = stream
         self._lock = threading.Lock()
         self._version = -1
         self._replicas: list = []
@@ -183,8 +204,15 @@ class DeploymentHandle:
         # router's load view (reset on refresh: replica set changed)
         self._outstanding: dict[bytes, int] = {}
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._controller, method_name)
+    def options(self, *, method_name: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
+        """``stream=True``: calls return an ObjectRefGenerator — the
+        replica method must be a generator; items stream back with
+        backpressure (reference: handle.options(stream=True))."""
+        return DeploymentHandle(
+            self._controller,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream)
 
     def _refresh(self) -> None:
         version, replicas, kv_key = _api().get(
@@ -254,13 +282,25 @@ class DeploymentHandle:
         # decrements on completion
         _internal_kv_incr(self._kv_key, 1, namespace="serve")
         self._controller.tick.remote()      # fire-and-forget scale poke
+        if self._stream:
+            gen = ActorMethod(replica, "__serve_call__",
+                              num_returns="streaming").remote(
+                self._method, args, kwargs)
+            # streaming load settles optimistically (no single seal to
+            # observe); the KV inflight decrements at generator return
+            with self._lock:
+                c = self._outstanding.get(rkey, 0)
+                if c > 0:
+                    self._outstanding[rkey] = c - 1
+            return gen
         ref = ActorMethod(replica, "__serve_call__").remote(
             self._method, args, kwargs)
         self._settle(rkey, ref)
         return ref
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._controller, self._method))
+        return (DeploymentHandle,
+                (self._controller, self._method, self._stream))
 
 
 # -- deployment / application ------------------------------------------------
