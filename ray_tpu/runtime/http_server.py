@@ -76,6 +76,55 @@ class BackgroundHTTPServer:
         request.wfile.write(body)
 
     @staticmethod
+    def reply_stream(request, chunks, content_type: str,
+                     status: int = 200) -> None:
+        """Streaming response: chunked transfer for HTTP/1.1 clients,
+        close-delimited raw bytes for HTTP/1.0 (which cannot decode
+        chunk framing).
+
+        Error discipline: the FIRST chunk is produced before any header
+        goes out, so a handler that fails immediately still gets a clean
+        500 from the caller's error path.  A failure AFTER headers
+        truncates the stream WITHOUT the chunked terminator — the client
+        detects the truncation — and is swallowed here (propagating
+        would let the dispatcher append a second response to the same
+        socket)."""
+        it = iter(chunks)
+        try:        # producer errors propagate: no headers sent yet,
+            first = next(it)        # so the caller's error path 500s
+        except StopIteration:
+            first = b""
+            it = iter(())
+        chunked = request.request_version != "HTTP/1.0"
+        if chunked:
+            request.protocol_version = "HTTP/1.1"
+        request.send_response(status)
+        request.send_header("Content-Type", content_type)
+        if chunked:
+            request.send_header("Transfer-Encoding", "chunked")
+        request.end_headers()
+
+        def write(chunk: bytes) -> None:
+            if not chunk:
+                return
+            if chunked:
+                request.wfile.write(
+                    f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            else:
+                request.wfile.write(chunk)
+            request.wfile.flush()
+        try:
+            write(first)
+            for chunk in it:
+                write(chunk)
+            if chunked:
+                request.wfile.write(b"0\r\n\r\n")
+        except Exception:   # noqa: BLE001 — mid-stream failure: leave
+            pass            # the stream visibly truncated (no
+            #                 terminator), never a second response
+        request.close_connection = True
+
+    @staticmethod
     def not_found(request) -> None:
         request.send_response(404)
         request.end_headers()

@@ -362,7 +362,12 @@ def deployment(target: type | Callable | None = None, *,
 
 
 def _wrap_function(fn: Callable) -> type:
+    import inspect
+
     class _FnReplica:
+        # function deployments that are generators stream over HTTP too
+        _serve_http_stream = inspect.isgeneratorfunction(fn)
+
         def __call__(self, *args, **kwargs):
             return fn(*args, **kwargs)
     _FnReplica.__name__ = getattr(fn, "__name__", "fn_replica")
@@ -436,7 +441,15 @@ def run(app: Application, *, name: str = "default",
     ray_tpu.get(controller.num_replicas.remote(), timeout=60)
     handle = DeploymentHandle(controller)
     if route_prefix is not None:
-        _ensure_ingress().add_route(route_prefix, handle)
+        # a generator __call__ makes the HTTP route STREAMING: chunked
+        # transfer of each yielded item (reference streaming responses)
+        import inspect
+        http_stream = (
+            inspect.isgeneratorfunction(
+                getattr(dep._target, "__call__", None))
+            or getattr(dep._target, "_serve_http_stream", False))
+        _ensure_ingress().add_route(route_prefix, handle,
+                                    stream=http_stream)
     with _apps_lock:
         old = _apps.pop(name, None)
         _apps[name] = _Running(controller, handle, dep, route_prefix)

@@ -52,17 +52,27 @@ class HttpIngress(BackgroundHTTPServer):
     def address(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def add_route(self, prefix: str, handle) -> None:
+    def add_route(self, prefix: str, handle,
+                  stream: bool = False) -> None:
+        """``stream=True``: the deployment's handler is a GENERATOR —
+        responses go out with chunked transfer encoding, one chunk per
+        yielded item (reference: Serve streaming HTTP responses)."""
         prefix = _norm_prefix(prefix)
+        # the stream-mode handle is built ONCE here: a per-request
+        # options() would pay a controller refresh per request and
+        # discard the router's load view
+        stream_handle = handle.options(stream=True) if stream else None
         with self._rlock:
-            self._routes[prefix] = handle
+            self._routes[prefix] = (handle, stream_handle)
 
     def remove_route(self, prefix: str, handle=None) -> None:
         """Drop a route; with ``handle`` given, only if that handle
         still owns it (a later app may have claimed the prefix)."""
         prefix = _norm_prefix(prefix)
         with self._rlock:
-            if handle is None or self._routes.get(prefix) is handle:
+            entry = self._routes.get(prefix)
+            if handle is None or (entry is not None
+                                  and entry[0] is handle):
                 self._routes.pop(prefix, None)
 
     def routes(self) -> list[str]:
@@ -78,7 +88,8 @@ class HttpIngress(BackgroundHTTPServer):
             self.reply(request, json.dumps(self.routes()).encode(),
                        "application/json")
             return
-        handle = self._match(path)
+        matched = self._match(path)
+        handle, stream_handle = matched if matched else (None, None)
         if handle is None:
             self.reply(request, json.dumps(
                 {"error": "NotFound",
@@ -110,6 +121,21 @@ class HttpIngress(BackgroundHTTPServer):
         body = request.rfile.read(n) if n else b""
         req = HTTPRequest(method=request.command, path=path,
                           query=dict(parse_qsl(parts.query)), body=body)
+        if stream_handle is not None:
+            gen = stream_handle.remote(req)
+
+            def chunks():
+                for ref in gen:
+                    item = ray_tpu.get(ref, timeout=self._timeout)
+                    if isinstance(item, (bytes, bytearray)):
+                        yield bytes(item)
+                    elif isinstance(item, str):
+                        yield item.encode()
+                    else:       # JSON lines for structured items
+                        yield json.dumps(item).encode() + b"\n"
+            self.reply_stream(request, chunks(),
+                              "application/octet-stream")
+            return
         result = ray_tpu.get(handle.remote(req), timeout=self._timeout)
         if isinstance(result, (bytes, bytearray)):
             self.reply(request, bytes(result), "application/octet-stream")
@@ -121,14 +147,15 @@ class HttpIngress(BackgroundHTTPServer):
                        "application/json")
 
     def _match(self, path: str):
-        """Longest-prefix route match on path-segment boundaries."""
+        """Longest-prefix route match on path-segment boundaries;
+        returns (handle, stream) or None."""
         with self._rlock:
             best = None
-            for prefix, handle in self._routes.items():
+            for prefix, entry in self._routes.items():
                 if path == prefix or prefix == "/" or \
                         path.startswith(prefix + "/"):
                     if best is None or len(prefix) > len(best[0]):
-                        best = (prefix, handle)
+                        best = (prefix, entry)
             return best[1] if best else None
 
 

@@ -247,6 +247,31 @@ class TestServeStreaming:
         finally:
             serve.shutdown()
 
+    def test_http_route_streams_chunked(self, driver):
+        """A generator __call__ on a routed deployment streams over
+        HTTP with chunked transfer encoding."""
+        import urllib.request
+
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class Streamer:
+            def __call__(self, request):
+                n = int(request.query.get("n", 3))
+                for i in range(n):
+                    yield f"part-{i}|"
+
+        serve.run(Streamer.bind(), route_prefix="/stream")
+        try:
+            base = serve.http_address()
+            with urllib.request.urlopen(f"{base}/stream?n=4",
+                                        timeout=60) as r:
+                assert r.headers.get("Transfer-Encoding") == "chunked"
+                body = r.read()
+            assert body == b"part-0|part-1|part-2|part-3|"
+        finally:
+            serve.shutdown()
+
 
 class TestStreamingDataPipeline:
     def test_100_block_pipeline_bounded_occupancy(self, driver):
