@@ -131,9 +131,36 @@ def measure_plane_throughput(mb: int = 32) -> float:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _arm_watchdog(seconds: float = 600.0) -> None:
+    """The dev-tunnel backend init can hang INDEFINITELY during tunnel
+    outages (observed 2026-07-30: jax.devices() blocked >3h).  A hung
+    bench records nothing; a clearly-marked failure line records the
+    outage.  value=-1 is a sentinel, never a measurement."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "p50 heartbeat time: 1M tasks x 1k nodes "
+                      "[TPU TUNNEL UNREACHABLE: backend init exceeded "
+                      f"{seconds:.0f}s; see rtt_control history]",
+            "value": -1.0,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        os._exit(3)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    # disarm once the backend is live (main() replaces this no-op)
+    _arm_watchdog.cancel = t.cancel
+
+
 def main():
     import jax
     import jax.numpy as jnp
+
+    _arm_watchdog()
 
     from ray_tpu.ops import schedule_grouped
     from ray_tpu.scheduling import threshold_fp
@@ -155,6 +182,7 @@ def main():
     # warmup/compile (np.asarray is the reliable sync on every backend)
     np.asarray(pack_rounds([schedule_grouped(*args)[0]
                             for _ in range(ROUNDS)]))
+    _arm_watchdog.cancel()      # backend is live: measurements proceed
 
     rtt_before = measure_rtt()
 
