@@ -103,6 +103,7 @@ class ObjectRefGenerator:
         self._rt = runtime
         self._i = 0
         self._closed = False
+        self._transferred = False
 
     def _runtime(self):
         if self._rt is None:    # deserialized: rebind to this process
@@ -142,10 +143,15 @@ class ObjectRefGenerator:
     def close(self) -> None:
         """Finish with the stream: cancels a still-running producer and
         reclaims sealed-but-unconsumed items.  Called automatically at
-        exhaustion and at garbage collection."""
+        exhaustion and at garbage collection.  A generator that was
+        SERIALIZED (shipped into a task) transferred its consumption
+        ownership — the local copy's close/GC must not cancel the
+        stream out from under the new consumer."""
         if self._closed:
             return
         self._closed = True
+        if self._transferred:
+            return
         try:
             self._runtime().stream_close(self._task_id, self._i)
         except Exception:   # noqa: BLE001 — teardown/GC: best-effort
@@ -162,6 +168,7 @@ class ObjectRefGenerator:
         return self._task_id
 
     def __reduce__(self):
+        self._transferred = True    # the deserialized copy consumes
         return (ObjectRefGenerator, (self._task_id, None))
 
 

@@ -1547,6 +1547,28 @@ class Raylet:
                 self.task_manager.stream_item_sealed(tid, msg[2])
         elif kind == "stream_end":
             self.task_manager.stream_finished(TaskID(msg[1]))
+        elif kind == "stream_wait":
+            # a WORKER consuming a stream: block like the get path
+            # (resources return while it waits; this reader thread is
+            # the worker's, so frames for it queue behind — the
+            # existing blocking-get discipline)
+            tid, index, timeout = TaskID(msg[1]), msg[2], msg[3]
+            # fast path (like get): already satisfiable => no blocked-
+            # worker dance (resource return/re-debit + recall per item)
+            sealed, done, err = self.task_manager.wait_stream(
+                tid, index, 0)
+            if not (sealed > index or done):
+                rec = self._rec_of_worker(worker)
+                self._enter_blocked(worker, rec)
+                sealed, done, err = self.task_manager.wait_stream(
+                    tid, index, timeout)
+                self._exit_blocked(worker, rec)
+            worker.send(("stream_wait_reply", sealed, done,
+                         serialize(err) if err is not None else None))
+        elif kind == "stream_ack_up":
+            self.cluster.stream_ack(TaskID(msg[1]), msg[2])
+        elif kind == "stream_close_up":
+            self.cluster.stream_close(TaskID(msg[1]), msg[2])
         elif kind == "refs":
             # this worker's batched local incref/decref events fold
             # against its holder entry (distributed refcounting)

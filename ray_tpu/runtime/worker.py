@@ -42,7 +42,8 @@ from .serialization import RayTaskError, deserialize, serialize
 
 # reply frame kinds the reader routes to the API reply queue
 _REPLY_KINDS = frozenset({"get_reply", "get_reply_x", "wait_reply",
-                          "kv_reply", "named_actor_reply"})
+                          "kv_reply", "named_actor_reply",
+                          "stream_wait_reply"})
 
 
 class ArgRef:
@@ -216,15 +217,29 @@ class WorkerApiContext:
     def stream_wait_budget(self, task_id_bin: bytes, produced: int,
                            window: int) -> bool:
         """Generator backpressure: pause until the consumer has acked
-        within ``window`` of what we produced.  The wait is indefinite —
-        a slow-but-alive consumer keeps memory bounded, and an ABANDONED
-        stream cancels cooperatively (ObjectRefGenerator close/GC sends
-        stream_cancel).  Returns False when cancelled: stop yielding."""
+        within ``window`` of what we produced.  A slow-but-alive
+        consumer keeps memory bounded (every ack re-arms the clock);
+        an ABANDONED stream normally cancels cooperatively
+        (ObjectRefGenerator close/GC sends stream_cancel), and the
+        10-minute no-progress cap catches ORPHANED streams whose
+        consumer can never close them (a transferred generator whose
+        carrier task died before delivery) — the producer then stops
+        yielding instead of holding its worker forever.  Returns False
+        when the producer should stop."""
+        import time as _time
+        deadline = _time.monotonic() + 600.0
         with self._stream_cv:
+            last = self._stream_acks.get(task_id_bin, 0)
             while produced - self._stream_acks.get(task_id_bin, 0) \
                     >= window:
                 if task_id_bin in self._stream_cancelled:
                     return False
+                acked = self._stream_acks.get(task_id_bin, 0)
+                if acked > last:        # consumer alive: re-arm
+                    last = acked
+                    deadline = _time.monotonic() + 600.0
+                if _time.monotonic() >= deadline:
+                    return False        # orphaned: stop producing
                 self._stream_cv.wait(1.0)
             return task_id_bin not in self._stream_cancelled
 
@@ -308,21 +323,23 @@ class WorkerApiContext:
         self.flush_refs()
         self.send(("submit", serialize(spec), fn_id, fn_bytes))
 
-    # streaming-generator consumption is driver-side (v1): a worker
-    # holding an ObjectRefGenerator surfaces a clear error instead of
-    # silently hanging
+    # streaming-generator CONSUMPTION from inside a worker: waits and
+    # acks proxy through the raylet, so ObjectRefGenerators chain
+    # through tasks (a task can consume another task's or actor's
+    # stream — reference: generators are first-class task arguments)
     def stream_wait(self, task_id, index, timeout=None):
-        raise RuntimeError(
-            "ObjectRefGenerator consumption inside a worker is not "
-            "supported; consume the stream in the driver")
+        with self._api_lock:
+            self.send(("stream_wait", task_id.binary(), index, timeout))
+            reply = self._recv_reply("stream_wait_reply")
+        sealed, done, err_bytes = reply[1], reply[2], reply[3]
+        return sealed, done, \
+            deserialize(err_bytes) if err_bytes else None
 
     def stream_ack(self, task_id, consumed) -> None:
-        raise RuntimeError(
-            "ObjectRefGenerator consumption inside a worker is not "
-            "supported; consume the stream in the driver")
+        self.send(("stream_ack_up", task_id.binary(), consumed))
 
     def stream_close(self, task_id, consumed) -> None:
-        pass        # nothing held worker-side (see stream_wait)
+        self.send(("stream_close_up", task_id.binary(), consumed))
 
     def kv_op(self, op: str, key: bytes, value: bytes | None = None,
               namespace: str = "", overwrite: bool = True):

@@ -219,6 +219,39 @@ class TestActorStreaming:
                 next(g)
 
 
+class TestWorkerStreamConsumption:
+    def test_task_consumes_another_tasks_stream(self, driver):
+        """ObjectRefGenerators chain through tasks: a consumer task
+        iterates a producer task's stream via its raylet proxy."""
+        @ray_tpu.remote(num_returns="streaming")
+        def producer(n):
+            for i in range(n):
+                yield i * 2
+
+        @ray_tpu.remote
+        def consumer(gen):
+            return sum(ray_tpu.get(r, timeout=30) for r in gen)
+
+        g = producer.remote(10)
+        assert ray_tpu.get(consumer.remote(g), timeout=90) == 90
+
+    def test_task_consumes_actor_stream(self, driver):
+        @ray_tpu.remote
+        class Gen:
+            def produce(self, n):
+                for i in range(n):
+                    yield i + 1
+
+        @ray_tpu.remote
+        def total(gen):
+            return sum(ray_tpu.get(r, timeout=30) for r in gen)
+
+        a = Gen.remote()
+        g = a.produce.options(num_returns="streaming").remote(5)
+        assert ray_tpu.get(total.remote(g), timeout=90) == 15
+        ray_tpu.kill(a)
+
+
 class TestServeStreaming:
     def test_serve_handle_streams(self, driver):
         from ray_tpu import serve
