@@ -189,9 +189,10 @@ class HeadNode:
 
     def _stream_wait(self, task_bin: bytes, index: int,
                      timeout: float | None):
-        sealed, done, error = self._rt.stream_wait(TaskID(task_bin),
-                                                   index, timeout)
-        return sealed, done, serialize(error) if error else None
+        sealed, done, error, known = self._rt.stream_wait(
+            TaskID(task_bin), index, timeout)
+        return (sealed, done, serialize(error) if error else None,
+                known)
 
     def _stream_ack(self, task_bin: bytes, consumed: int) -> None:
         self._rt.stream_ack(TaskID(task_bin), consumed)

@@ -104,12 +104,24 @@ class ObjectRefGenerator:
         self._i = 0
         self._closed = False
         self._transferred = False
+        # deserialized copies carry no runtime: they are the TRANSFER
+        # target of a one-shot stream (see close()/__reduce__)
+        self._from_wire = runtime is None
 
     def _runtime(self):
         if self._rt is None:    # deserialized: rebind to this process
             from .. import api
             self._rt = api._get_runtime()
         return self._rt
+
+    @staticmethod
+    def _unpack(reply):
+        """(sealed, done, error, known) — older 3-field runtimes imply
+        known=True."""
+        if len(reply) == 4:
+            return reply
+        sealed, done, error = reply
+        return sealed, done, error, True
 
     def __iter__(self):
         return self
@@ -118,15 +130,26 @@ class ObjectRefGenerator:
         if self._closed:
             raise StopIteration
         rt = self._runtime()
-        sealed, done, error = rt.stream_wait(self._task_id, self._i,
-                                             2.0)
+        sealed, done, error, known = self._unpack(
+            rt.stream_wait(self._task_id, self._i, 2.0))
         if self._i >= sealed and not done:
             # no progress in the grace window: re-ack our position (a
             # retried producer restarts with an empty ack table and
             # only this unblocks its backpressure), then wait for real
             rt.stream_ack(self._task_id, self._i)
-            sealed, done, error = rt.stream_wait(self._task_id,
-                                                 self._i, None)
+            sealed, done, error, known = self._unpack(
+                rt.stream_wait(self._task_id, self._i, None))
+        if not known and self._from_wire and self._i == 0:
+            # a deserialized copy against a REAPED stream: the one-shot
+            # stream was consumed elsewhere (e.g. this consumer task
+            # retried after already draining it) — fail loudly rather
+            # than yielding a silently empty stream
+            self._closed = True
+            raise RuntimeError(
+                "stream already consumed: ObjectRefGenerators are "
+                "one-shot, and this copy arrived after the stream was "
+                "drained and reaped (generator args are incompatible "
+                "with task retries)")
         if self._i >= sealed:
             self.close()
             if error is not None:

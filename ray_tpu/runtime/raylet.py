@@ -1546,7 +1546,24 @@ class Raylet:
                 self.store.put_remote(oid, d[2])
                 self.task_manager.stream_item_sealed(tid, msg[2])
         elif kind == "stream_end":
-            self.task_manager.stream_finished(TaskID(msg[1]))
+            tid = TaskID(msg[1])
+            if len(msg) > 3 and msg[3]:
+                # the producer STALLED out (no consumer ack for the
+                # orphan window): finish the stream with a loud error
+                # and reclaim its sealed payloads — a slow consumer
+                # fails visibly (the errored state is retained) and
+                # nothing big leaks in a long-lived driver
+                orphans = self.task_manager.stream_abandon(
+                    tid, RayTaskError(
+                        "stream", "stream producer stalled: no "
+                        "consumer ack within the orphan window (the "
+                        "consuming side died, or took >10 minutes "
+                        "between items)"))
+                for oid in orphans:
+                    if self.store.contains(oid):
+                        self.cluster._reclaim_object(oid)
+            else:
+                self.task_manager.stream_finished(tid)
         elif kind == "stream_wait":
             # a WORKER consuming a stream: block like the get path
             # (resources return while it waits; this reader thread is
@@ -1555,16 +1572,17 @@ class Raylet:
             tid, index, timeout = TaskID(msg[1]), msg[2], msg[3]
             # fast path (like get): already satisfiable => no blocked-
             # worker dance (resource return/re-debit + recall per item)
-            sealed, done, err = self.task_manager.wait_stream(
+            sealed, done, err, known = self.task_manager.wait_stream(
                 tid, index, 0)
             if not (sealed > index or done):
                 rec = self._rec_of_worker(worker)
                 self._enter_blocked(worker, rec)
-                sealed, done, err = self.task_manager.wait_stream(
-                    tid, index, timeout)
+                sealed, done, err, known = \
+                    self.task_manager.wait_stream(tid, index, timeout)
                 self._exit_blocked(worker, rec)
             worker.send(("stream_wait_reply", sealed, done,
-                         serialize(err) if err is not None else None))
+                         serialize(err) if err is not None else None,
+                         known))
         elif kind == "stream_ack_up":
             self.cluster.stream_ack(TaskID(msg[1]), msg[2])
         elif kind == "stream_close_up":

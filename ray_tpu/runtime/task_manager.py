@@ -227,8 +227,10 @@ class TaskManager:
     def wait_stream(self, task_id: TaskID, index: int,
                     timeout: float | None = None):
         """Block until item ``index+1`` exists or the stream finished.
-        Returns (sealed, done, error); (0, True, None) for an unknown
-        stream (never opened, or reaped => treat as ended)."""
+        Returns (sealed, done, error, known); known=False means the
+        stream was never opened or already reaped (closed + done) —
+        consumers distinguish a one-shot stream consumed elsewhere from
+        a legitimately empty one."""
         import time
         deadline = None if timeout is None else \
             time.monotonic() + timeout
@@ -236,16 +238,37 @@ class TaskManager:
             while True:
                 st = self._streams.get(task_id)
                 if st is None:
-                    return 0, True, None
+                    return 0, True, None, False
                 if st.sealed > index or st.done:
-                    return st.sealed, st.done, st.error
+                    return st.sealed, st.done, st.error, True
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return st.sealed, st.done, st.error
+                        return st.sealed, st.done, st.error, True
                     self._stream_cv.wait(remaining)
                 else:
                     self._stream_cv.wait()
+
+    def stream_abandon(self, task_id: TaskID, error) -> list:
+        """Producer-side stall teardown: finish the stream with the
+        error (RETAINING the state so a slow consumer's next wait sees
+        a loud failure, not a clean end) and return every sealed item
+        for reclamation — the payloads must not leak even though the
+        error tombstone stays until the consumer closes."""
+        with self._stream_cv:
+            st = self._streams.get(task_id)
+            if st is None:
+                return []
+            st.done = True
+            if st.error is None:
+                st.error = error
+            orphans = [ObjectID.for_task_return(task_id, i)
+                       for i in range(1, st.sealed + 1)]
+            rec = self._records.get(task_id)
+            if rec is not None:
+                rec.dead_returns.update(orphans)
+            self._stream_cv.notify_all()
+        return orphans
 
     def stream_close(self, task_id: TaskID, consumed: int) -> list:
         """The consumer is done with a stream (exhausted it or abandoned

@@ -233,15 +233,16 @@ class WorkerApiContext:
             while produced - self._stream_acks.get(task_id_bin, 0) \
                     >= window:
                 if task_id_bin in self._stream_cancelled:
-                    return False
+                    return "cancelled"
                 acked = self._stream_acks.get(task_id_bin, 0)
                 if acked > last:        # consumer alive: re-arm
                     last = acked
                     deadline = _time.monotonic() + 600.0
                 if _time.monotonic() >= deadline:
-                    return False        # orphaned: stop producing
+                    return "stalled"    # orphaned: stop producing
                 self._stream_cv.wait(1.0)
-            return task_id_bin not in self._stream_cancelled
+            return "cancelled" if task_id_bin in self._stream_cancelled \
+                else "ok"
 
     def stream_done(self, task_id_bin: bytes) -> None:
         with self._stream_cv:
@@ -332,8 +333,9 @@ class WorkerApiContext:
             self.send(("stream_wait", task_id.binary(), index, timeout))
             reply = self._recv_reply("stream_wait_reply")
         sealed, done, err_bytes = reply[1], reply[2], reply[3]
-        return sealed, done, \
-            deserialize(err_bytes) if err_bytes else None
+        known = reply[4] if len(reply) > 4 else True
+        return (sealed, done,
+                deserialize(err_bytes) if err_bytes else None, known)
 
     def stream_ack(self, task_id, consumed) -> None:
         self.send(("stream_ack_up", task_id.binary(), consumed))
@@ -539,19 +541,25 @@ def _stream_results(ctx: WorkerApiContext, task_id_bin: bytes, out,
     window = max(get_config().streaming_backpressure_items, 1)
     ctx.stream_begin(task_id_bin)
     idx = 0
+    verdict = "ok"
     try:
         for item in out:
             idx += 1
             data, inner = serialize_collecting(item)
             ctx.send(("stream_item", task_id_bin, idx, data, inner))
             item = data = inner = None
-            if not ctx.stream_wait_budget(task_id_bin, idx, window):
-                break   # consumer closed the stream
+            verdict = ctx.stream_wait_budget(task_id_bin, idx, window)
+            if verdict != "ok":
+                break   # consumer closed the stream / orphaned
     finally:
         if hasattr(out, "close"):
             out.close()     # GeneratorExit into user code
         ctx.stream_done(task_id_bin)
-    ctx.send(("stream_end", task_id_bin, idx))
+    # a STALLED end is distinguishable: the head finishes the stream
+    # with an error + tears it down, so a slow-but-alive consumer gets
+    # a loud failure instead of a silently truncated clean end
+    ctx.send(("stream_end", task_id_bin, idx,
+              verdict == "stalled"))
     ctx.send((result_kind, task_id_bin, [], []))
 
 
@@ -605,6 +613,7 @@ async def _stream_results_async(ctx, task_id_bin: bytes, out) -> None:
     loop = asyncio.get_running_loop()
     ctx.stream_begin(task_id_bin)
     idx = 0
+    verdict = "ok"
     try:
         async for item in out:
             idx += 1
@@ -612,9 +621,9 @@ async def _stream_results_async(ctx, task_id_bin: bytes, out) -> None:
             ctx.send(("stream_item", task_id_bin, idx, data, inner))
             item = data = inner = None
             # backpressure wait off the loop thread (it blocks)
-            ok = await loop.run_in_executor(
+            verdict = await loop.run_in_executor(
                 None, ctx.stream_wait_budget, task_id_bin, idx, window)
-            if not ok:
+            if verdict != "ok":
                 break
     finally:
         try:
@@ -622,7 +631,8 @@ async def _stream_results_async(ctx, task_id_bin: bytes, out) -> None:
         except Exception:           # not at GC finalization
             pass
         ctx.stream_done(task_id_bin)
-    ctx.send(("stream_end", task_id_bin, idx))
+    ctx.send(("stream_end", task_id_bin, idx,
+              verdict == "stalled"))
     ctx.send(("actor_result", task_id_bin, [], []))
 
 

@@ -143,12 +143,14 @@ class ClientRuntime:
         # bounded server-side waits so one stream doesn't pin an RPC
         # worker thread forever; loop client-side for timeout=None
         while True:
-            sealed, done, err_bytes = self._call(
+            reply = self._call(
                 "stream_wait", task_id.binary(), index,
                 30.0 if timeout is None else timeout)
+            sealed, done, err_bytes = reply[0], reply[1], reply[2]
+            known = reply[3] if len(reply) > 3 else True
             err = deserialize(err_bytes) if err_bytes else None
             if sealed > index or done or timeout is not None:
-                return sealed, done, err
+                return sealed, done, err, known
 
     def stream_ack(self, task_id, consumed: int) -> None:
         self._call("stream_ack", task_id.binary(), consumed)

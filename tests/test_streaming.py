@@ -97,7 +97,7 @@ class TestBackpressure:
 
         g = gen.remote(64)
         time.sleep(2.0)     # no consumption: the producer must pause
-        sealed, done, _err = driver.stream_wait(g.task_id, 0, timeout=5)
+        sealed, done, _err, _known = driver.stream_wait(g.task_id, 0, timeout=5)
         assert not done
         assert sealed <= window + 1, (sealed, window)
         # now drain; everything arrives
