@@ -175,7 +175,9 @@ class TestConcurrentReplicas:
                           timeout=60)
         elapsed = time.monotonic() - t0
         assert out == ["ok"] * 4
-        assert elapsed < 2.6, elapsed   # serial would be >= 3.2
+        # the PROPERTY is overlap: serial is >= 3.2s; leave margin for
+        # a loaded CI machine (observed 2.65s under full-suite load)
+        assert elapsed < 3.0, elapsed
 
     def test_router_prefers_less_loaded_replica(self):
         """Power-of-two-choices: with one replica wedged by slow calls,
