@@ -362,7 +362,10 @@ class Cluster:
                     break
         for oid in orphans:
             if self.store.contains(oid):
-                self._reclaim_object(oid)
+                # through the counter, not _reclaim_object directly:
+                # refs pickled INSIDE sealed-but-unconsumed items must
+                # release with them (contained-entry bookkeeping)
+                self.ref_counter.force_reclaim(oid)
 
     # -- routing (spillback) ------------------------------------------------
     def route_local(self, row: int, task_id) -> bool:

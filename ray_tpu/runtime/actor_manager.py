@@ -414,11 +414,18 @@ class ActorManager:
                 rec.inflight[call.task_id.binary()] = call
                 import time as _time
                 call.sent_at = _time.time()
-                payload = serialize((tuple(vals), call.kwargs,
-                                     call.num_returns, call.trace_ctx,
-                                     call.group))
-                rec.worker.send(("actor_call", call.task_id.binary(),
-                                 call.method, payload))
+                from .object_ref import (mark_transferred,
+                                         transfer_generators)
+                with transfer_generators() as gens:
+                    payload = serialize((tuple(vals), call.kwargs,
+                                         call.num_returns,
+                                         call.trace_ctx, call.group))
+                if rec.worker.send(("actor_call",
+                                    call.task_id.binary(),
+                                    call.method, payload)):
+                    # only a SHIPPED frame moves stream consumption;
+                    # a dead-worker send keeps the caller's close()
+                    mark_transferred(gens)
         # head has missing deps: wake the pump when they land
         for d in missing:
             self._store.on_ready(d, lambda _o, a=actor_id: self._pump(a))

@@ -67,6 +67,31 @@ def counter_suppressed():
         _suppress.on = prev
 
 
+_gen_transfer = threading.local()
+
+
+@contextlib.contextmanager
+def transfer_generators():
+    """Collect ObjectRefGenerators pickled on THIS thread inside the
+    block WITHOUT marking them transferred; the caller marks them
+    (``mark_transferred``) only after the serialized bytes actually
+    ship to a consumer.  Pickles outside any such block keep the
+    legacy immediate one-shot side effect (see ``__reduce__``)."""
+    prev = getattr(_gen_transfer, "gens", None)
+    _gen_transfer.gens = []
+    try:
+        yield _gen_transfer.gens
+    finally:
+        _gen_transfer.gens = prev
+
+
+def mark_transferred(gens) -> None:
+    """The serialized frame containing these generators was handed to
+    its consumer: consumption ownership has moved."""
+    for g in gens:
+        g._transferred = True
+
+
 @contextlib.contextmanager
 def ref_collector():
     """Record every ObjectRef pickled on THIS thread inside the block.
@@ -191,7 +216,20 @@ class ObjectRefGenerator:
         return self._task_id
 
     def __reduce__(self):
-        self._transferred = True    # the deserialized copy consumes
+        gens = getattr(_gen_transfer, "gens", None)
+        if gens is not None:
+            # inside a transfer_generators() block (task/actor-call
+            # serialization): the sender marks us transferred only
+            # AFTER the bytes actually ship — a submit that fails after
+            # arg serialization keeps the local copy's close/cancel
+            gens.append(self)
+        else:
+            # stray pickle (deepcopy, logging, debug dumps): one-shot
+            # semantics apply immediately — the copy consumes, and this
+            # instance's close/cancel is permanently disabled.  If you
+            # hit this from a non-transfer pickle, don't pickle
+            # generators outside task submission.
+            self._transferred = True
         return (ObjectRefGenerator, (self._task_id, None))
 
 

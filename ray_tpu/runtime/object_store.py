@@ -272,12 +272,19 @@ class MemoryStore:
             with self._cv:
                 entry = self._objects.get(object_id)
                 if isinstance(entry, ShmEntry):
+                    # a peer asking past the end (stale size metadata,
+                    # malformed op_read) must not produce a negative-
+                    # length arena view on the RPC handler thread
+                    if offset < 0 or offset >= entry.size:
+                        return b""
                     entry.pins += 1
                     pin = (object_id, entry.offset)
                     view = self.arena.view(entry.offset + offset,
                                            min(length,
                                                entry.size - offset))
                 elif isinstance(entry, SpillEntry):
+                    if offset < 0:
+                        return b""      # seek would raise
                     path = entry.path
                     view = None
                 else:

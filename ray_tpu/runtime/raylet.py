@@ -1044,7 +1044,10 @@ class Raylet:
                 self._requeue_after_worker_loss(rec, worker)
                 return False
             worker.fn_cache.add(fn_id)
-        payload = serialize((tuple(args), spec.kwargs, spec.num_returns))
+        from .object_ref import mark_transferred, transfer_generators
+        with transfer_generators() as xfer_gens:
+            payload = serialize((tuple(args), spec.kwargs,
+                                 spec.num_returns))
         # lineage budget cost, measured here where the args are already
         # serialized (complete() must not re-pickle under the manager lock)
         rec.lineage_bytes = len(payload) + 256
@@ -1065,6 +1068,7 @@ class Raylet:
                 self.store.unpin(pinned)
                 self._requeue_after_worker_loss(rec, worker)
             return False
+        mark_transferred(xfer_gens)     # exec frame shipped
         return True
 
     def _pop_env_worker(self, task_id, rec, spec):
@@ -1561,7 +1565,8 @@ class Raylet:
                         "between items)"))
                 for oid in orphans:
                     if self.store.contains(oid):
-                        self.cluster._reclaim_object(oid)
+                        # counter-routed so contained refs release too
+                        self.cluster.ref_counter.force_reclaim(oid)
             else:
                 self.task_manager.stream_finished(tid)
         elif kind == "stream_wait":

@@ -60,8 +60,13 @@ class ReferenceCounter:
         # worker pool indexes are monotonic), so a tombstone safely
         # drops events that raced the holder's death — a late
         # refs_flush folding after holder_gone must not resurrect
-        # counts nothing will ever retire
+        # counts nothing will ever retire.  Bounded FIFO (a long-lived
+        # head with churning clients/workers must not grow forever):
+        # a tombstone only matters for the short window where a dead
+        # holder's final batch is still in flight, so evicting the
+        # oldest after _DEAD_HOLDER_CAP retirements is safe in practice
         self._dead_holders: set[tuple] = set()
+        self._dead_holder_fifo: deque = deque()
         self._contained: dict[ObjectID, tuple] = {}  # parent -> inner oids
         self._zero: set[ObjectID] = set()   # count hit 0, awaiting seal
         self._pinned: set[ObjectID] = set()
@@ -118,6 +123,17 @@ class ReferenceCounter:
         """A ref-holding process died/disconnected: retire every count
         it held (objects only it referenced become reclaimable)."""
         self._events.append(("g", None, holder))
+        self._wake.set()
+
+    def force_reclaim(self, object_id: ObjectID) -> None:
+        """Reclaim an orphaned object NOW regardless of counts (e.g.
+        sealed-but-unconsumed stream items of a closed/stalled stream —
+        no consumer ref will ever exist for them).  Routed through the
+        event queue so it folds in order with in-flight events, and
+        through ``_do_reclaim`` so contained refs and owner rows release
+        with the object instead of leaking under the ``('obj', parent)``
+        holder."""
+        self._events.append(("f", object_id, None))
         self._wake.set()
 
     # -- lifecycle -----------------------------------------------------------
@@ -217,6 +233,19 @@ class ReferenceCounter:
                         self._bump(inner, holder, 1, [])
                 elif kind == "g":
                     self._retire_holder(arg, dead)
+                elif kind == "f":
+                    # forced orphan reclaim: drop any stray counts so a
+                    # late decref cannot double-reclaim, then free
+                    holders = self._counts.pop(oid, None)
+                    if holders:
+                        for h in list(holders):
+                            hset = self._by_holder.get(h)
+                            if hset is not None:
+                                hset.discard(oid)
+                                if not hset:
+                                    del self._by_holder[h]
+                    self._zero.discard(oid)
+                    self._do_reclaim(oid)
             for oid in dead:
                 if oid in self._pinned or self._total(oid) > 0:
                     continue
@@ -237,8 +266,15 @@ class ReferenceCounter:
             if not processed and not self._events:
                 return
 
+    _DEAD_HOLDER_CAP = 4096
+
     def _retire_holder(self, holder: tuple, dead: list) -> None:
-        self._dead_holders.add(holder)
+        if holder not in self._dead_holders:
+            self._dead_holders.add(holder)
+            self._dead_holder_fifo.append(holder)
+            while len(self._dead_holder_fifo) > self._DEAD_HOLDER_CAP:
+                self._dead_holders.discard(
+                    self._dead_holder_fifo.popleft())
         for oid in list(self._by_holder.get(holder, ())):
             holders = self._counts.get(oid)
             if holders is None:
@@ -253,10 +289,15 @@ class ReferenceCounter:
         # objects OWNED by the dead holder with no counts from anyone
         # (e.g. a client that vanished before its first flush, a worker
         # whose events died in the pipe) die with it — otherwise they
-        # are unreachable forever
-        for oid in self._owned_by.get(holder, ()):
+        # are unreachable forever.  Survivors (counted by other holders)
+        # drop their owner row entirely: the owner is gone and objects
+        # outlive owner death by design here, so keeping the mapping
+        # would only leak _owned_by/_owner entries on a long-lived head
+        for oid in self._owned_by.pop(holder, ()):
             if self._total(oid) <= 0:
                 dead.append(oid)
+            else:
+                self._owner.pop(oid, None)
 
     def _drop_owner(self, oid: ObjectID) -> None:
         owner = self._owner.pop(oid, None)

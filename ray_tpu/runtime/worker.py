@@ -321,21 +321,45 @@ class WorkerApiContext:
         return ready, not_ready
 
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None):
+        from .object_ref import mark_transferred, transfer_generators
         self.flush_refs()
-        self.send(("submit", serialize(spec), fn_id, fn_bytes))
+        with transfer_generators() as gens:
+            payload = serialize(spec)
+        self.send(("submit", payload, fn_id, fn_bytes))
+        mark_transferred(gens)      # bytes shipped: consumption moved
 
     # streaming-generator CONSUMPTION from inside a worker: waits and
     # acks proxy through the raylet, so ObjectRefGenerators chain
     # through tasks (a task can consume another task's or actor's
     # stream — reference: generators are first-class task arguments)
     def stream_wait(self, task_id, index, timeout=None):
-        with self._api_lock:
-            self.send(("stream_wait", task_id.binary(), index, timeout))
-            reply = self._recv_reply("stream_wait_reply")
-        sealed, done, err_bytes = reply[1], reply[2], reply[3]
-        known = reply[4] if len(reply) > 4 else True
-        return (sealed, done,
-                deserialize(err_bytes) if err_bytes else None, known)
+        # bounded server-side waits looped client-side (the
+        # ClientRuntime pattern): the api lock releases between polls,
+        # so one call consuming a slow stream cannot head-of-line-block
+        # every other concurrent call's get/put/wait on this worker
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            # 15s server-side bound: long enough that the raylet's
+            # blocked-worker dance (recall/add_back/re-debit) stays
+            # rare churn, short enough that concurrent calls on this
+            # worker wait a bounded time for the api lock
+            if deadline is None:
+                step = 15.0
+            else:
+                step = min(15.0, max(0.0, deadline - _time.monotonic()))
+            with self._api_lock:
+                self.send(("stream_wait", task_id.binary(), index, step))
+                reply = self._recv_reply("stream_wait_reply")
+            sealed, done, err_bytes = reply[1], reply[2], reply[3]
+            known = reply[4] if len(reply) > 4 else True
+            if sealed > index or done or not known or \
+                    (deadline is not None
+                     and _time.monotonic() >= deadline):
+                return (sealed, done,
+                        deserialize(err_bytes) if err_bytes else None,
+                        known)
 
     def stream_ack(self, task_id, consumed) -> None:
         self.send(("stream_ack_up", task_id.binary(), consumed))
@@ -379,11 +403,14 @@ class WorkerApiContext:
                           kwargs, num_returns: int,
                           trace_ctx: tuple | None = None,
                           concurrency_group: str | None = None):
+        from .object_ref import mark_transferred, transfer_generators
         self.flush_refs()
+        with transfer_generators() as gens:
+            payload = serialize((args, kwargs, num_returns, trace_ctx,
+                                 concurrency_group))
         self.send(("actor_submit", actor_id.binary(),
-                   task_id.binary(), method,
-                   serialize((args, kwargs, num_returns, trace_ctx,
-                              concurrency_group))))
+                   task_id.binary(), method, payload))
+        mark_transferred(gens)
 
     def kill_actor(self, actor_id, no_restart: bool = True):
         self.send(("actor_kill", actor_id.binary(), no_restart))

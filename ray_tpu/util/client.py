@@ -91,8 +91,13 @@ class ClientRuntime:
 
     # -- core API (the surface api.py/actor_api.py dispatch to) --------------
     def submit_spec(self, spec, fn_id: str, fn_bytes: bytes | None) -> None:
-        self._call("submit_spec", serialize(spec), fn_id, fn_bytes,
+        from ..runtime.object_ref import (mark_transferred,
+                                          transfer_generators)
+        with transfer_generators() as gens:
+            payload = serialize(spec)
+        self._call("submit_spec", payload, fn_id, fn_bytes,
                    self.job_id.binary())
+        mark_transferred(gens)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
         kind, payload = self._call(
@@ -133,10 +138,14 @@ class ClientRuntime:
                           kwargs, num_returns: int,
                           trace_ctx: tuple | None = None,
                           concurrency_group: str | None = None) -> None:
+        from ..runtime.object_ref import (mark_transferred,
+                                          transfer_generators)
+        with transfer_generators() as gens:
+            payload = serialize((args, kwargs, trace_ctx,
+                                 concurrency_group))
         self._call("submit_actor_call", actor_id.binary(),
-                   task_id.binary(), method,
-                   serialize((args, kwargs, trace_ctx,
-                              concurrency_group)), num_returns)
+                   task_id.binary(), method, payload, num_returns)
+        mark_transferred(gens)
 
     def stream_wait(self, task_id, index: int,
                     timeout: float | None = None):
