@@ -1,0 +1,111 @@
+"""Control-plane scaling: cluster task throughput vs number of agent
+nodes (VERDICT r04 next-step #4; reference bar: multi-node scheduling
+throughput, BASELINE.md row 5).
+
+Two modes per cluster size:
+
+- ``head_dispatch``: the driver submits tiny tasks; every lease rides
+  the head's scheduler and every frame transits its RPC server — this
+  curve shows where the head-centric control plane saturates.
+- ``agent_local``: one fan-out parent per agent node; children lease
+  on their own machines through the autonomy fast path, so the head
+  sees only batched agent_sync calls — this curve shows what
+  raylet-per-host buys back.
+
+Writes one JSON line; run standalone:
+    python bench_scaling.py [--agents 1,2,4,8] [--tasks 240]
+
+Caveat recorded in the artifact: everything shares one small machine
+(agents are real processes-over-TCP but compete for the same cores),
+so absolute numbers are lower bounds and the SHAPE of the curves is
+the signal.
+"""
+
+import argparse
+import json
+import os
+import time
+
+
+def _run_cluster(n_agents: int, n_tasks: int) -> dict:
+    import ray_tpu
+    from ray_tpu.runtime.head import HeadNode
+    from ray_tpu.runtime.node_agent import NodeAgent
+
+    head = HeadNode(resources={"CPU": 2, "memory": 4}, num_workers=2)
+    agents = []
+    for i in range(n_agents):
+        agents.append(NodeAgent(
+            head.address,
+            resources={"CPU": 2, "memory": 4, f"slot{i}": 2},
+            num_workers=2))
+    deadline = time.monotonic() + 120
+    while len(ray_tpu.nodes()) != n_agents + 1:
+        assert time.monotonic() < deadline, "cluster never formed"
+        time.sleep(0.1)
+    out = {}
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        @ray_tpu.remote
+        def fanout(n):
+            refs = [noop.remote() for _ in range(n)]
+            ray_tpu.get(refs, timeout=300)
+            return n
+
+        # warmup: boot every node's workers + fn caches
+        ray_tpu.get([noop.remote() for _ in range(4 * (n_agents + 1))],
+                    timeout=120)
+        for i in range(n_agents):
+            p = fanout.options(resources={"CPU": 1, f"slot{i}": 1})
+            ray_tpu.get(p.remote(2), timeout=120)
+
+        # mode 1: driver-submitted tiny tasks, head-placed
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n_tasks)],
+                    timeout=600)
+        out["head_dispatch_tasks_per_s"] = round(
+            n_tasks / (time.perf_counter() - t0), 1)
+
+        # mode 2: one fan-out parent per agent, children lease locally
+        per = n_tasks // max(n_agents, 1)
+        t0 = time.perf_counter()
+        parents = [
+            fanout.options(resources={"CPU": 1, f"slot{i}": 1}).remote(
+                per) for i in range(n_agents)]
+        ray_tpu.get(parents, timeout=600)
+        out["agent_local_tasks_per_s"] = round(
+            (per * n_agents) / (time.perf_counter() - t0), 1)
+    finally:
+        for a in agents:
+            a.stop()
+        head.stop()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", default="1,2,4,8")
+    ap.add_argument("--tasks", type=int, default=240)
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.agents.split(",")]
+    curve = {}
+    for n in sizes:
+        curve[str(n)] = _run_cluster(n, args.tasks)
+    result = {
+        "metric": "cluster_task_throughput_vs_agent_count",
+        "unit": "tasks/s",
+        "tasks_per_point": args.tasks,
+        "hardware": {"nproc": os.cpu_count(),
+                     "note": "single machine; agents are real "
+                             "TCP-linked processes sharing the cores "
+                             "— curve shape is the signal"},
+        "curve": curve,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -204,6 +204,7 @@ class RemoteFunction:
         # embeds the id — a content hash would be circular (reference keys
         # its GCS function table the same way: descriptor, not digest).
         self._fn_id = fn_id or os.urandom(16).hex()
+        self._submit_cache = None   # (ResourceRequest, wire num_returns)
 
     # -- options ------------------------------------------------------------
     def options(self, *, num_returns: int | None = None,
@@ -271,8 +272,33 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         rt = _get_runtime()
         fn_id, fn_bytes = self._materialize()
+        # submission invariants (demand vector, wire num_returns) are
+        # per-FUNCTION and config-independent, so computed once — the
+        # tiny-task submit path mints thousands of specs/s.  The retry
+        # default is read per call: Config.reset between init cycles
+        # must keep applying (it's one attribute read).
         retries = self._max_retries if self._max_retries is not None \
             else get_config().task_max_retries_default
+        cached = self._submit_cache
+        if cached is None:
+            from .common.task_spec import SchedulingStrategyKind
+            res = self._resources
+            if self._strategy.kind is \
+                    SchedulingStrategyKind.PLACEMENT_GROUP:
+                # rewrite the demand onto the group's shaped bundle
+                # resources (reference: PG tasks consume
+                # ``CPU_group_{i}_{pgid}``)
+                from .runtime.placement_group_manager import shape_request
+                res = shape_request(
+                    res, self._strategy.placement_group_id.hex(),
+                    self._strategy.bundle_index)
+            # "streaming" rides the wire as -1: the task is a GENERATOR
+            # and its items seal incrementally (num_returns="streaming")
+            num_returns = -1 if self._num_returns == "streaming" \
+                else self._num_returns
+            cached = (ResourceRequest(res), num_returns)
+            self._submit_cache = cached
+        rreq, num_returns = cached
         if rt.is_driver:
             job_id = rt.job_id
             task_id = TaskID.for_task(job_id)
@@ -280,25 +306,12 @@ class RemoteFunction:
             cur = rt.current_task_id
             job_id = cur.job_id() if cur else JobID.from_int(0)
             task_id = TaskID.for_task(job_id)
-        from .common.task_spec import SchedulingStrategyKind
-        res = self._resources
-        if self._strategy.kind is SchedulingStrategyKind.PLACEMENT_GROUP:
-            # rewrite the demand onto the group's shaped bundle resources
-            # (reference: PG tasks consume ``CPU_group_{i}_{pgid}``)
-            from .runtime.placement_group_manager import shape_request
-            res = shape_request(res,
-                                self._strategy.placement_group_id.hex(),
-                                self._strategy.bundle_index)
         from .util.tracing import context_for_new_task
-        # "streaming" rides the wire as -1: the task is a GENERATOR and
-        # its items seal incrementally (reference num_returns="streaming")
-        num_returns = -1 if self._num_returns == "streaming" \
-            else self._num_returns
         spec = TaskSpec(
             task_id=task_id, job_id=job_id, task_type=TaskType.NORMAL_TASK,
             function_descriptor=fn_id, args=args, kwargs=kwargs,
             num_returns=num_returns,
-            resources=ResourceRequest(res),
+            resources=rreq,
             strategy=self._strategy, max_retries=retries,
             runtime_env=self._runtime_env,  # the job-level env merges in
             #                                 at the raylet submit intake
@@ -443,8 +456,9 @@ def init(resources: dict[str, float] | None = None,
         _runtime.cluster.default_namespace = namespace or ""
         # the cluster carries the job-level default env: EVERY spec
         # intake (driver submits, worker-submitted children, actor
-        # creation) merges against it, so inheritance is uniform
-        _runtime.cluster.job_runtime_env = runtime_env
+        # creation) merges against it, so inheritance is uniform —
+        # set_job_runtime_env also gates agents' env-blind fast path
+        _runtime.cluster.set_job_runtime_env(runtime_env)
 
 
 def is_initialized() -> bool:

@@ -112,6 +112,7 @@ class Cluster:
         from .runtime.runtime_env import RuntimeEnvManager
         self.runtime_env_manager = RuntimeEnvManager(self.session_dir)
         self.job_runtime_env = None           # set by api.init(runtime_env=)
+        self.on_job_env_change = None         # AgentHub policy push hook
         self.default_namespace = ""           # set by api.init(namespace=):
         #   worker-side named-actor ops inherit it (workers carry no
         #   namespace of their own)
@@ -164,6 +165,15 @@ class Cluster:
             if addr is not None:
                 self.plane.free_on(addr, [oid])
         self.task_manager.on_return_reclaimed(oid)
+
+    def set_job_runtime_env(self, env: dict | None) -> None:
+        """Install the job-level default runtime_env and notify any
+        attached agent hub: autonomous agents are env-blind, so a job
+        env appearing must gate their fast path off."""
+        self.job_runtime_env = env
+        hook = self.on_job_env_change
+        if hook is not None:
+            hook(env)
 
     def _expects_seal(self, oid) -> bool:
         """Will an absent object ever seal?  Only a pending task return
@@ -229,12 +239,18 @@ class Cluster:
                         plane_address: str | None = None) -> NodeID:
         """A node whose worker processes live behind a node agent on
         another machine (``runtime/node_agent.py``): same raylet, same
-        scheduling row — only the process transport differs.  With a
-        ``plane_address`` the agent runs its own arena and objects move
-        arena-to-arena over the object plane (exec/get frames carry
-        by-reference descriptors the agent resolves locally); without
-        one, every payload ships in-band through the head (legacy
-        relay-only agents)."""
+        scheduling row — only the process transport differs.  The
+        agent ALWAYS runs its own arena (``plane_address`` is
+        mandatory): objects move arena-to-arena over the object plane,
+        exec/get frames carry by-reference descriptors the agent
+        resolves locally.  The legacy relay-only mode (every payload
+        in-band through the head) is gone — one data-plane code path."""
+        if plane_address is None:
+            raise ValueError(
+                "remote nodes require a plane_address: relay-only "
+                "agents (payloads in-band through the head) were "
+                "removed — run a NodeAgent, which always serves an "
+                "object plane")
         return self.add_node(resources=resources, num_workers=num_workers,
                              labels=labels, spawner=spawner,
                              inline_objects=True,

@@ -24,6 +24,30 @@ from typing import ClassVar
 
 _NIL = b"\xff"
 
+# fast unique-bytes stream: one getrandom(2) syscall per TaskID
+# (~30 us each) dominates the tiny-task submit path, so hot-path ids
+# draw from an os.urandom-seeded PRNG instead — full 64-bit entropy
+# per draw (collision odds identical to true-random bytes), reseeded
+# on fork/spawn (pid check) so child processes never share a stream
+_fast_rng = None
+_fast_rng_pid = -1
+_fast_rng_lock = threading.Lock()
+
+
+def fast_random_bytes(n: int) -> bytes:
+    global _fast_rng, _fast_rng_pid
+    rng = _fast_rng
+    if rng is None or _fast_rng_pid != os.getpid():
+        import random
+        with _fast_rng_lock:
+            if _fast_rng is None or _fast_rng_pid != os.getpid():
+                _fast_rng = random.Random(os.urandom(32))
+                _fast_rng_pid = os.getpid()
+            rng = _fast_rng
+    # randbytes is a single C call: atomic under the GIL, so concurrent
+    # threads get distinct (never interleaved/corrupted) draws
+    return rng.randbytes(n)
+
 
 class BaseID:
     """Immutable binary id. Subclasses fix SIZE (bytes)."""
@@ -143,7 +167,7 @@ class TaskID(BaseID):
     @classmethod
     def for_task(cls, job_id: JobID, actor_id: ActorID | None = None) -> "TaskID":
         actor = actor_id if actor_id is not None else ActorID.nil_for_job(job_id)
-        return cls(os.urandom(8) + actor.binary())
+        return cls(fast_random_bytes(8) + actor.binary())
 
     @classmethod
     def deterministic(cls, seed: bytes, actor_id: ActorID) -> "TaskID":

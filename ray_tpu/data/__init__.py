@@ -17,10 +17,14 @@ batches, not Python-loop rows), and ``split`` hands aligned shards to
 """
 
 from .aggregate import GroupedDataset, read_csv, read_text
+from .block import (ColumnBlock, iter_block_files, read_block_file,
+                    write_block_file, write_blocks)
 from .dataset import Dataset, from_items, from_numpy, range  # noqa: A004
-from .streaming import (DataStream, stream_blocks, stream_from_items,
-                        stream_range)
+from .streaming import (DataStream, stream_block_files, stream_blocks,
+                        stream_from_items, stream_range)
 
-__all__ = ["DataStream", "Dataset", "GroupedDataset", "from_items",
-           "from_numpy", "range", "read_csv", "read_text",
-           "stream_blocks", "stream_from_items", "stream_range"]
+__all__ = ["ColumnBlock", "DataStream", "Dataset", "GroupedDataset",
+           "from_items", "from_numpy", "iter_block_files", "range",
+           "read_block_file", "read_csv", "read_text",
+           "stream_block_files", "stream_blocks", "stream_from_items",
+           "stream_range", "write_block_file", "write_blocks"]

@@ -183,6 +183,126 @@ def schedule_grouped(totals, avail, node_mask, group_reqs, group_counts,
     return counts, new_avail
 
 
+def _keys_one_req_host(totals, avail, req, thr_fp, mask):
+    """Pure-numpy twin of ``_keys_one_req`` (int64 host arithmetic;
+    values are int32-bounded by the contract audit, so results are
+    bit-identical)."""
+    n = totals.shape[0]
+    req_pos = req > 0
+    feas = np.where(req_pos[None, :], totals >= req[None, :],
+                    True).all(axis=1) & mask
+    availb = np.where(req_pos[None, :], avail >= req[None, :],
+                      True).all(axis=1)
+    denom = np.maximum(totals, 1)
+    q = totals - avail + req[None, :]
+    s = np.where(req_pos[None, :], (q * SCALE) // denom, 0).max(
+        axis=1, initial=0)
+    eff = np.where(availb & (s < thr_fp), 0, s)
+    key = ((~availb).astype(np.int64) << AVAIL_SHIFT) \
+        | (eff << SCORE_SHIFT) | np.arange(n, dtype=np.int64)
+    return np.where(feas, key, np.int64(_INF_KEY))
+
+
+def schedule_group_host(avail, totals, node_mask, req, count,
+                        gmask=None, thr_fp=None, pref_row=-1,
+                        require_available=False):
+    """Pure-NUMPY water-fill for ONE scheduling class — no jit, no
+    device transfer: the raylet's small-round dispatch path, where a
+    per-round device round-trip would cost more than the math.  Same
+    closed-form water-fill as ``_schedule_group`` (bit-identical; the
+    parity suite compares all three of oracle/device/host).
+
+    ``pref_row`` >= 0 applies the soft-locality semantics of
+    ``schedule_grouped_localized``: a FEASIBLE preferred node takes the
+    whole class (availability only gates consumption); fallback to the
+    water-fill fires only when the preferred node is infeasible.
+
+    Returns ``(counts_row (N+1,) int32, new_avail (N, R) int64)``;
+    column N counts infeasible/queued-nowhere tasks.
+    """
+    from ..scheduling.contract import threshold_fp
+    if thr_fp is None:
+        thr_fp = threshold_fp(None)
+    thr_fp = int(thr_fp)
+    totals = np.asarray(totals, np.int64)
+    avail = np.asarray(avail, np.int64)
+    node_mask = np.asarray(node_mask, bool)
+    req = np.asarray(req, np.int64)
+    n = totals.shape[0]
+    if gmask is None:
+        gmask = np.ones(n, dtype=bool)
+    req_pos = req > 0
+    count = int(count)
+
+    if pref_row is not None and pref_row >= 0:
+        p = min(max(int(pref_row), 0), n - 1)
+        feas_p = bool(np.where(req_pos, totals[p] >= req, True).all()
+                      and node_mask[p] and gmask[p])
+        m = count if feas_p else 0
+        cap_p = int(np.where(req_pos, avail[p] // np.maximum(req, 1),
+                             _BIG).min(initial=_BIG))
+        consumed = min(m, max(cap_p, 0))
+        avail2 = avail.copy()
+        avail2[p] -= req * consumed
+        rest, avail3 = schedule_group_host(
+            avail2, totals, node_mask, req, count - m, gmask, thr_fp,
+            pref_row=-1, require_available=require_available)
+        rest[p] += m
+        return rest, avail3
+
+    any_req = bool(req_pos.any())
+    used = totals - avail
+    feas = np.where(req_pos[None, :], totals >= req[None, :],
+                    True).all(axis=1) & node_mask & gmask
+    caps = np.where(req_pos[None, :],
+                    avail // np.maximum(req, 1)[None, :], _BIG)
+    m_max = np.where(feas & any_req,
+                     caps.min(axis=1).clip(0, _BIG), 0)
+    total_cap = int(m_max.sum())
+    n_avail = min(count, total_cap)
+    overflow = count - n_avail
+
+    denom_req = np.maximum(req * SCALE, 1)[None, :]
+    used_scaled = used * SCALE
+
+    def m_of(L):
+        Lp = thr_fp - 1 if L < thr_fp else L
+        num = (Lp + 1) * totals - used_scaled - 1
+        jc = (num // denom_req).clip(0, _BIG)
+        jcount = np.where(req_pos[None, :], jc, _BIG).min(axis=1)
+        return np.minimum(m_max, jcount)
+
+    lo, hi = 0, 2 * SCALE
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if int(m_of(mid).sum()) >= n_avail:
+            hi = mid
+        else:
+            lo = mid + 1
+    l_star = lo
+    base = m_of(l_star - 1) if l_star > 0 else np.zeros(n, np.int64)
+    extra = m_of(l_star) - base
+    rem = n_avail - int(base.sum())
+    prefix = np.cumsum(extra) - extra
+    give = (rem - prefix).clip(0, extra)
+    alloc = base + give
+    new_avail = avail - alloc[:, None] * req[None, :]
+
+    okeys = _keys_one_req_host(totals, new_avail, req, thr_fp,
+                               node_mask & gmask)
+    onode = int(np.argmin(okeys))
+    infeasible = okeys[onode] == _INF_KEY
+    ocol = n if infeasible else onode
+    if require_available:
+        o_avail = (int(okeys[onode]) >> AVAIL_SHIFT) & 1 == 0
+        if infeasible or not o_avail:
+            ocol = n
+    counts_row = np.zeros(n + 1, np.int32)
+    counts_row[:n] = alloc
+    counts_row[ocol] += overflow
+    return counts_row, new_avail
+
+
 def schedule_grouped_np(totals, avail, node_mask, group_reqs, group_counts,
                         group_masks=None, thr_fp=None, spread_threshold=None):
     """Convenience host wrapper: numpy in/out, device compute."""

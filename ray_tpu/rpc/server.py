@@ -34,6 +34,9 @@ class RpcServer:
         # Tests use this to PROVE data-plane payloads bypass a server
         # (e.g. object transfers never transiting the head).
         self.method_bytes: dict = {}
+        # per-method REQUEST counts (same proof role as the bytes:
+        # e.g. asserting N local leases cost O(1) head calls)
+        self.method_calls: dict = {}
         self._mb_lock = threading.Lock()
         # per-connection cleanup callbacks (registered by handlers via
         # on_conn_close while serving a request on that connection) —
@@ -95,6 +98,9 @@ class RpcServer:
                 row = self.method_bytes[method] = [0, 0]
             row[0] += n_in
             row[1] += n_out
+            if n_in:        # request leg only (replies re-account out)
+                self.method_calls[method] = \
+                    self.method_calls.get(method, 0) + 1
 
     def total_bytes(self, exclude: tuple = ()) -> int:
         """Sum of request+reply wire bytes across methods (minus any in

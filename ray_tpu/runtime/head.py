@@ -169,7 +169,7 @@ class HeadNode:
         job_id = JobID.next()
         with self._lock:    # check-then-set: FIRST env-bearing client
             if job_runtime_env and not self._rt.cluster.job_runtime_env:
-                self._rt.cluster.job_runtime_env = job_runtime_env
+                self._rt.cluster.set_job_runtime_env(job_runtime_env)
         counter = self._rt.cluster.ref_counter
         am = self._rt.actor_manager
 

@@ -57,6 +57,10 @@ from .worker_pool import LocalSpawner
 
 _EOF = object()
 
+# the head's add_node default — the register reply normally carries the
+# effective resources, this is only the fallback for a headless boot
+DEFAULT_NODE_RESOURCES = {"CPU": 2, "memory": 2}
+
 
 def _make_agent_arena(session_dir: str):
     """The agent machine's own arena (plasma analogue): /dev/shm when
@@ -83,9 +87,37 @@ def _make_agent_arena(session_dir: str):
 
 class NodeAgent:
     """The daemon on a worker machine: spawn + relay + local object
-    plane.  Frame relay stays dumb except where the data plane demands
-    resolution (by-reference descriptors) or extraction (big payloads
-    seal locally; metadata rides up)."""
+    plane + AUTONOMOUS LOCAL DISPATCH.  Frame relay stays dumb except
+    where the data plane demands resolution (by-reference descriptors)
+    or extraction (big payloads seal locally; metadata rides up).
+
+    Raylet-per-host (VERDICT r04 missing #2, SURVEY §7 step 8): a
+    worker here submitting ``f.remote()`` no longer pays a head
+    round-trip per lease.  The agent keeps a LOCAL availability view
+    (seeded from the register reply's resources; head-dispatched execs
+    carry their demand vector as a stripped 7th frame element) and an
+    observed per-worker state (ready/inflight/dedicated/env).  An
+    eligible nested submission — DEFAULT strategy, no runtime_env,
+    non-streaming, function bytes known, all ObjectRef args resident
+    in the LOCAL arena, resources available, an idle local worker —
+    dispatches straight to that worker from the pump thread.  Nothing
+    blocks on the head: ownership/lineage metadata folds up on a
+    BATCHED ``agent_sync`` (started specs + done results + live local
+    load), which the head registers into its TaskManager/refcounter so
+    gets, retries, lineage recovery, and node-death drain behave
+    exactly as for head-dispatched tasks (the head reconciles return
+    refs on registration to close the fire-and-forget decref race).
+    Ineligible submissions relay to the head unchanged — the head's
+    global batch kernel IS the spillback path.  Local gets of
+    locally-resident plasma objects are served from the agent arena
+    the same way (no head round-trip).
+
+    Known v1 limits, by design: head-side ``ray.cancel`` cannot reach
+    an agent-leased task (no frame addresses it); a local worker death
+    hands the task BACK to the head with a ``retry`` disposition
+    rather than retrying in place; transient resource oversubscription
+    between the head's CRM and the local view is bounded by the worker
+    pool (the same class of slack as ``force_subtract``)."""
 
     def __init__(self, head_address: str,
                  resources: dict[str, float] | None = None,
@@ -130,12 +162,40 @@ class NodeAgent:
         self._exec_pins: dict[tuple[int, bytes], list] = {}
         self._get_pins: dict[int, deque] = {}
         self._pin_lock = threading.Lock()
+        # -- autonomous local dispatch state --------------------------------
+        self._fast_enabled = False      # head policy (register reply)
+        self._view_lock = threading.Lock()
+        self._totals_cu: dict[str, int] = {}
+        self._avail_cu: dict[str, int] = {}
+        # index -> {"ready","dedicated","env","inflight","fns"}
+        self._w_state: dict[int, dict] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._fn_cache: dict[str, bytes] = {}
+        self._fn_fetching: set[str] = set()     # in-flight head fetches
+        self._fn_uploaded: set[str] = set()     # bytes shipped headward
+        self._head_tasks: dict[bytes, tuple] = {}   # tid -> (cu, index)
+        self._local_tasks: dict[bytes, dict] = {}   # agent-leased
+        # accepted-but-undispatched local leases (FIFO, the raylet's
+        # dispatch queue): drains as workers/resources free; entries
+        # older than the lease timeout hand back to the head
+        self._local_queue: deque = deque()
+        self._LOCAL_QUEUE_CAP = 1024
+        self._sync_lock = threading.Lock()
+        # ONE ordered batch of ("refs"|"started"|"done", ...) entries:
+        # a single stream preserves every intra-agent ordering the
+        # head's counter fold depends on (a parent's incref for a
+        # child's return is enqueued before that child's done entry,
+        # so the head folds them in that order too)
+        self._sync_batch: list = []
+        self._sync_wake = threading.Event()
+        self._sync_thread: threading.Thread | None = None
         handlers = {
             "a_spawn": self._a_spawn,
             "a_send": self._a_send,
             "a_kill": self._a_kill,
             "a_stop": self._a_stop,
             "a_ping": lambda: "ok",
+            "a_policy": self._a_policy,
         }
         handlers.update(self.plane.handlers())
         self.server = RpcServer(handlers, host=host, port=port).start()
@@ -154,10 +214,11 @@ class NodeAgent:
                     self._head = RpcClient(head_address,
                                            on_close=self._on_head_lost)
                     self.agent_id = NodeID.from_random().hex()
-                    self.node_id_hex = self._head.call(
+                    reply = self._head.call(
                         "agent_register", self.agent_id,
                         self.server.address, resources, num_workers,
                         labels, True)
+                    self._apply_register_reply(reply, resources)
                     break
                 except Exception:
                     if _time.monotonic() >= deadline:
@@ -169,6 +230,35 @@ class NodeAgent:
         finally:
             with self._lock:
                 self._reconnecting = False
+        self._sync_thread = threading.Thread(
+            target=self._sync_loop, daemon=True, name="agent-sync")
+        self._sync_thread.start()
+
+    def _apply_register_reply(self, reply, resources) -> None:
+        """Register reply: dict with the node's EFFECTIVE resources and
+        the head's fast-path policy (a bare node-id hex from an older
+        head keeps autonomy off)."""
+        from ..common.resources import ResourceRequest
+        if isinstance(reply, dict):
+            self.node_id_hex = reply["node_id"]
+            eff = reply.get("resources") or resources \
+                or DEFAULT_NODE_RESOURCES
+            fast = bool(reply.get("fast_path", False))
+        else:
+            self.node_id_hex = reply
+            eff = resources or DEFAULT_NODE_RESOURCES
+            fast = False
+        cu = ResourceRequest(eff).cu()
+        with self._view_lock:
+            self._totals_cu = dict(cu)
+            self._avail_cu = dict(cu)
+        self._fast_enabled = fast
+
+    def _a_policy(self, policy: dict) -> bool:
+        """Head policy push (e.g. a job-level runtime_env appearing
+        gates the env-blind fast path off)."""
+        self._fast_enabled = bool(policy.get("fast_path", False))
+        return True
 
     # -- head failover -------------------------------------------------------
     def _on_head_lost(self) -> None:
@@ -204,6 +294,7 @@ class NodeAgent:
         with self._pin_lock:
             self._exec_pins.clear()
             self._get_pins.clear()
+        self._reset_autonomy_state()
         self.store.delete([oid for oid, _s, _k
                            in self.store.list_objects()])
         try:
@@ -217,10 +308,11 @@ class NodeAgent:
                     # pump threads relay through self._head/agent_id
                     self._head = head
                     self.agent_id = NodeID.from_random().hex()
-                    self.node_id_hex = self._head.call(
+                    reply = self._head.call(
                         "agent_register", self.agent_id,
                         self.server.address, self._resources,
                         self._num_workers, self._labels, True)
+                    self._apply_register_reply(reply, self._resources)
                     return      # rejoined
                 except Exception:   # noqa: BLE001 — head still down
                     if head is not None:
@@ -230,6 +322,25 @@ class NodeAgent:
         finally:
             with self._lock:
                 self._reconnecting = False
+
+    def _reset_autonomy_state(self) -> None:
+        """Head gone/replaced: agent-leased tasks can never report
+        their done-sync — drop them (the head's drain fails/retries
+        registered ones, exactly like node death)."""
+        self._fast_enabled = False
+        with self._sync_lock:
+            self._sync_batch.clear()
+        entries = list(self._local_tasks.values())
+        self._local_tasks.clear()
+        with self._view_lock:
+            self._local_queue.clear()
+        for e in entries:
+            self.store.unpin(e["pins"])
+        self._head_tasks.clear()
+        self._fn_uploaded.clear()       # the new head has a fresh registry
+        with self._view_lock:
+            self._avail_cu = dict(self._totals_cu)
+        self._w_state.clear()
 
     def wait_for_shutdown(self, timeout: float | None = None) -> bool:
         return self._stop_event.wait(timeout)
@@ -251,9 +362,29 @@ class NodeAgent:
         with self._lock:
             self._workers[index] = (proc, conn)
             epoch = self._epoch
+            self._send_locks.setdefault(index, threading.Lock())
+            self._w_state[index] = {"ready": False, "dedicated": False,
+                                    "env": env_payload is not None,
+                                    "inflight": 0, "fns": set()}
         threading.Thread(target=self._pump, args=(index, conn, epoch),
                          daemon=True, name=f"agent-pump-{index}").start()
         return proc.pid or 0
+
+    def _send_to_worker(self, index: int, msg) -> bool:
+        """Serialized pipe write: head-relayed frames (``a_send``
+        handler threads) and agent-local dispatch (pump threads) both
+        target the same worker conn."""
+        with self._lock:
+            entry = self._workers.get(index)
+            lock = self._send_locks.setdefault(index, threading.Lock())
+        if entry is None:
+            return False
+        with lock:
+            try:
+                entry[1].send(msg)
+                return True
+            except (OSError, BrokenPipeError):
+                return False
 
     def _a_send(self, index: int, msg) -> bool:
         with self._lock:
@@ -268,12 +399,10 @@ class NodeAgent:
         except Exception:   # noqa: BLE001 — unexpected surgery failure:
             msg = original      # forward as-is; the worker surfaces an
             #                     unresolved-descriptor error, not a hang
-        try:
-            entry[1].send(msg)
+        if self._send_to_worker(index, msg):
             return True
-        except (OSError, BrokenPipeError):
-            self._release_frame_pins(index, msg)
-            return False
+        self._release_frame_pins(index, msg)
+        return False
 
     def _a_kill(self, index: int) -> None:
         with self._lock:
@@ -313,10 +442,37 @@ class NodeAgent:
     # -- data-plane frame surgery -------------------------------------------
     def _rewrite_down(self, index: int, msg):
         """Head->worker: resolve by-reference descriptors against the
-        LOCAL store (pin for the read's duration).  Returns the frame to
-        forward, or None to swallow it (resolution failure already sent
-        an error frame up)."""
+        LOCAL store (pin for the read's duration) and OBSERVE the
+        frame stream for the autonomy state (fn cache, per-worker
+        inflight, dedicated marking, resource debits).  Returns the
+        frame to forward, or None to swallow it (resolution failure
+        already sent an error frame up)."""
         kind = msg[0]
+        state = self._w_state.get(index)
+        if kind == "fn":
+            self._fn_cache[msg[1]] = msg[2]
+            if state is not None:
+                state["fns"].add(msg[1])
+        elif kind in ("actor_new", "actor_call"):
+            if state is not None:
+                state["dedicated"] = True
+        elif kind == "exec":
+            if len(msg) == 7:
+                # plane frame: the head appended the task's demand cu
+                # dict — strip it (workers know nothing of it) and
+                # debit the local availability view until the result
+                cu = msg[6]
+                msg = msg[:6]
+                if state is not None:
+                    with self._lock:    # inflight is multi-thread RMW
+                        state["inflight"] += 1
+                self._head_tasks[msg[1]] = (cu, index)
+                with self._view_lock:
+                    for k, v in (cu or {}).items():
+                        self._avail_cu[k] = self._avail_cu.get(k, 0) - v
+            elif state is not None:
+                with self._lock:
+                    state["inflight"] += 1
         if kind == "exec" and len(msg) == 6 and msg[5]:
             extern, pins = [], []
             try:
@@ -330,6 +486,7 @@ class NodeAgent:
                         extern.append(d)
             except KeyError:
                 self.store.unpin(pins)
+                self._credit_head_task(msg[1])
                 self._send_error_up(
                     index, msg[1],
                     "task arg is not resident on this node's object "
@@ -365,9 +522,54 @@ class NodeAgent:
 
     def _rewrite_up(self, index: int, msg):
         """Worker->head: big payloads seal into the LOCAL store and only
-        metadata rides up; pin releases ride the task lifecycle."""
+        metadata rides up; pin releases ride the task lifecycle.
+        Returns None to SWALLOW a frame the agent fully handled
+        (autonomous dispatch, locally-served gets)."""
         kind = msg[0]
+        if kind == "ready":
+            state = self._w_state.get(index)
+            if state is not None:
+                state["ready"] = True
+            self._drain_local_queue()
+        elif kind == "submit":
+            # ("submit", spec_bytes, fn_id, fn_bytes): the autonomy
+            # fast path — dispatch locally with NO head round-trip
+            # when eligible; relay for global placement otherwise
+            try:
+                if self._try_local_dispatch(index, msg[1], msg[2],
+                                            msg[3]):
+                    return None
+            except Exception:   # noqa: BLE001 — fast path must never
+                pass            # drop a submission; fall through
+            return msg
+        elif kind == "refs":
+            # coalesce ref-count batches into the sync stream instead
+            # of one head call per flush: a tiny-task fan-out's pump
+            # thread must not serialize on a head RTT between a refs
+            # frame and the submit frame behind it
+            with self._sync_lock:
+                self._sync_batch.append(("refs", index, msg[1]))
+            self._sync_wake.set()
+            return None
+        elif kind in ("result", "error") and msg[1] in self._local_tasks:
+            try:
+                self._on_local_done(index, msg)
+            except Exception:   # noqa: BLE001 — a failed completion
+                # hand the task back to the head as a retry rather
+                # than losing it
+                entry = self._local_tasks.pop(msg[1], None)
+                if entry is not None:
+                    self.store.unpin(entry["pins"])
+                    self._finish_local(entry, None, None, None, "retry")
+            return None
+        elif kind == "get":
+            served = self._try_local_get(index, msg)
+            if served:
+                return None
+            return msg
         if kind in ("result", "actor_result"):
+            if kind == "result":
+                self._credit_head_task(msg[1])
             self._release_exec_pins(index, msg[1])
             tid = TaskID(msg[1])
             descs, any_big = [], False
@@ -387,6 +589,8 @@ class NodeAgent:
                 return (kind + "_x", msg[1], descs) + tuple(msg[3:])
             return msg
         if kind in ("error", "actor_error"):
+            if kind == "error":
+                self._credit_head_task(msg[1])
             self._release_exec_pins(index, msg[1])
             return msg
         if kind == "stream_item":
@@ -414,7 +618,10 @@ class NodeAgent:
                 batch = dq.popleft() if dq else None
             if batch:
                 self.store.unpin(batch)
-            return msg
+            # swallowed: the head never tracks pins for plane workers
+            # (their s-descriptors are all resolved HERE), so the ack
+            # is purely local — relaying it is a wasted head frame
+            return None
         return msg
 
     def _send_error_up(self, index: int, task_id_bin: bytes,
@@ -434,12 +641,29 @@ class NodeAgent:
         if pins:
             self.store.unpin(pins)
 
+    def _credit_head_task(self, tid_bin: bytes) -> None:
+        """A head-dispatched task finished (or will never run): return
+        its demand to the local view, drop the worker's inflight."""
+        entry = self._head_tasks.pop(tid_bin, None)
+        if entry is None:
+            return
+        cu, index = entry
+        with self._lock:
+            state = self._w_state.get(index)
+            if state is not None and state["inflight"] > 0:
+                state["inflight"] -= 1
+        with self._view_lock:
+            for k, v in (cu or {}).items():
+                self._avail_cu[k] = self._avail_cu.get(k, 0) + v
+        self._drain_local_queue()       # a worker/resources just freed
+
     def _release_frame_pins(self, index: int, msg) -> None:
         """A rewritten frame failed to send: release the pins it carried
         (its ack/result will never come)."""
         kind = msg[0]
         if kind == "exec":
             self._release_exec_pins(index, msg[1])
+            self._credit_head_task(msg[1])
         elif kind == "get_reply_x":
             with self._pin_lock:
                 dq = self._get_pins.get(index)
@@ -458,6 +682,328 @@ class NodeAgent:
         if pins:
             self.store.unpin(pins)
 
+    # -- autonomous local dispatch ------------------------------------------
+    def _try_local_dispatch(self, submitter: int, spec_bytes: bytes,
+                            fn_id: str, fn_bytes) -> bool:
+        """ACCEPT a nested submission for local execution: eligible
+        tasks enter the agent's FIFO dispatch queue (registered at the
+        head via the started-sync) and drain as workers/resources
+        free.  Returns True when the task was taken (the submit frame
+        must then be swallowed); False relays it to the head for
+        global placement."""
+        if not self._fast_enabled:
+            return False
+        sub = self._w_state.get(submitter)
+        if sub is None or sub["env"] or sub["dedicated"]:
+            # env/actor parents imply runtime-env inheritance the
+            # agent cannot evaluate — the head merges those
+            return False
+        if fn_bytes is None and fn_id not in self._fn_cache:
+            # a stub submission (bytes live only in the head's fn
+            # registry): relay THIS one, but fetch the bytes in the
+            # background so the rest of the fan-out fast-paths —
+            # one head round-trip per function EVER, off every
+            # dispatch path
+            self._fetch_fn_async(fn_id)
+            return False
+        from ..common.task_spec import SchedulingStrategyKind
+        from .object_ref import ObjectRef
+        from .serialization import deserialize
+        spec = deserialize(spec_bytes)
+        if spec.strategy.kind is not SchedulingStrategyKind.DEFAULT \
+                or spec.runtime_env or spec.num_returns < 0:
+            return False
+        from .object_store import PLASMA_KINDS
+        for a in spec.args:
+            if isinstance(a, ObjectRef):
+                kind, _ = self.store.plasma_info(a.id)
+                if kind not in PLASMA_KINDS:
+                    return False    # not locally materializable
+        cu = spec.resources.cu()
+        with self._view_lock:
+            if len(self._local_queue) >= self._LOCAL_QUEUE_CAP:
+                return False
+            for k, v in cu.items():
+                if self._totals_cu.get(k, 0) < v:
+                    return False    # infeasible here, ever
+            import time as _time
+            entry = {"spec": spec, "spec_bytes": spec_bytes,
+                     "fn_id": fn_id, "fn_bytes": fn_bytes,
+                     "submitter": submitter, "cu": cu,
+                     "enq": _time.monotonic()}
+            # started rides the sync BEFORE any dispatch: the result
+            # can arrive arbitrarily fast, and its done entry must
+            # never reach the head in a flush preceding registration.
+            # Worker-defined functions' bytes ride along ONCE per fn —
+            # the head's registry must stay complete (retries and
+            # lineage reconstruction resolve fn_id there) even though
+            # the submit frame that carried them was swallowed
+            up_bytes = None
+            if fn_bytes is not None and fn_id not in self._fn_uploaded:
+                self._fn_uploaded.add(fn_id)
+                up_bytes = fn_bytes
+            with self._sync_lock:
+                self._sync_batch.append(
+                    ("started", spec_bytes, submitter, fn_id,
+                     up_bytes))
+            self._local_queue.append(entry)
+        self._sync_wake.set()
+        # the task is ACCEPTED from here on: a drain hiccup must not
+        # unwind the accept (the caller would relay the submit and the
+        # task would run twice) — the queue drains on the next trigger
+        try:
+            self._drain_local_queue()
+        except Exception:   # noqa: BLE001
+            pass
+        return True
+
+    def _fetch_fn_async(self, fn_id: str) -> None:
+        with self._lock:
+            if fn_id in self._fn_fetching or fn_id in self._fn_cache:
+                return
+            self._fn_fetching.add(fn_id)
+
+        def run() -> None:
+            try:
+                data = self._head.call("agent_fn", fn_id, timeout=30.0)
+                if data is not None:
+                    self._fn_cache[fn_id] = data
+            except Exception:   # noqa: BLE001 — head gone/slow: the
+                pass            # next stub submission retries
+            finally:
+                with self._lock:
+                    self._fn_fetching.discard(fn_id)
+        threading.Thread(target=run, daemon=True,
+                         name=f"agent-fn-{fn_id[:8]}").start()
+
+    def _drain_local_queue(self) -> None:
+        """Dispatch queued local leases FIFO while a default worker is
+        idle and the head entry's resources are available (strict FIFO:
+        a head that cannot fit parks the queue, the same fairness the
+        head raylet's class buckets give)."""
+        while True:
+            with self._view_lock:
+                if not self._local_queue:
+                    return
+                entry = self._local_queue[0]
+                cu = entry["cu"]
+                for k, v in cu.items():
+                    if self._avail_cu.get(k, 0) < v:
+                        return
+                windex = None
+                with self._lock:
+                    for i, st in self._w_state.items():
+                        if st["ready"] and not st["dedicated"] \
+                                and not st["env"] \
+                                and st["inflight"] == 0 \
+                                and i in self._workers:
+                            windex = i
+                            break
+                    if windex is None:
+                        return
+                    self._w_state[windex]["inflight"] += 1
+                for k, v in cu.items():
+                    self._avail_cu[k] = self._avail_cu.get(k, 0) - v
+                self._local_queue.popleft()
+            try:
+                ok = self._dispatch_now(entry, windex)
+            except Exception:   # noqa: BLE001 — a failed dispatch must
+                ok = False      # undo its lease, never leak it
+            if not ok:
+                # worker vanished / args freed: undo the lease and hand
+                # the (already-registered) task back to the head —
+                # "requeue" re-enters global scheduling without
+                # consuming a retry attempt (the task never ran)
+                with self._view_lock:
+                    with self._lock:
+                        st = self._w_state.get(windex)
+                        if st is not None and st["inflight"] > 0:
+                            st["inflight"] -= 1
+                    for k, v in entry["cu"].items():
+                        self._avail_cu[k] = self._avail_cu.get(k, 0) + v
+                self._finish_local(entry, None, None, None, "requeue")
+
+    def _dispatch_now(self, entry: dict, windex: int) -> bool:
+        from .object_ref import ObjectRef
+        from .serialization import serialize
+        from .worker import ArgRef
+        spec, fn_id = entry["spec"], entry["fn_id"]
+        args, pins = [], []
+        try:
+            for a in spec.args:
+                if isinstance(a, ObjectRef):
+                    desc = self.store.descriptor_of(a.id)
+                    if desc[0] == "s":
+                        pins.append((a.id, desc[1]))
+                    args.append(ArgRef(desc))
+                else:
+                    args.append(a)
+        except KeyError:        # freed between accept and dispatch
+            self.store.unpin(pins)
+            return False
+        state = self._w_state.get(windex)
+        if state is not None and fn_id not in state["fns"]:
+            data = entry["fn_bytes"] if entry["fn_bytes"] is not None \
+                else self._fn_cache.get(fn_id)
+            if data is None or not self._send_to_worker(
+                    windex, ("fn", fn_id, data)):
+                self.store.unpin(pins)
+                return False
+            state["fns"].add(fn_id)
+            self._fn_cache.setdefault(fn_id, data)
+        payload = serialize((tuple(args), spec.kwargs,
+                             spec.num_returns))
+        tid_bin = spec.task_id.binary()
+        entry["index"] = windex
+        entry["pins"] = pins
+        self._local_tasks[tid_bin] = entry
+        if not self._send_to_worker(
+                windex, ("exec", tid_bin, fn_id, payload,
+                         spec.trace_ctx, None)):
+            self._local_tasks.pop(tid_bin, None)
+            self.store.unpin(pins)
+            return False
+        return True
+
+    def _on_local_done(self, index: int, msg) -> None:
+        """Terminal frame of an agent-leased task: seal big returns
+        into the LOCAL arena, queue the metadata for the batched
+        done-sync, free the lease."""
+        kind, tid_bin = msg[0], msg[1]
+        entry = self._local_tasks.pop(tid_bin, None)
+        if entry is None:
+            return
+        self.store.unpin(entry["pins"])
+        with self._lock:
+            st = self._w_state.get(entry["index"])
+            if st is not None and st["inflight"] > 0:
+                st["inflight"] -= 1
+        with self._view_lock:
+            for k, v in entry["cu"].items():
+                self._avail_cu[k] = self._avail_cu.get(k, 0) + v
+        if kind == "error":
+            self._finish_local(entry, None, None, msg[2], "error")
+            self._drain_local_queue()
+            return
+        tid = TaskID(tid_bin)
+        descs = []
+        for i, data in enumerate(msg[2]):
+            if len(data) > self.store._threshold:
+                oid = ObjectID.for_task_return(tid, i + 1)
+                self.store.put_serialized(oid, data)
+                k, size = self.store.plasma_info(oid)
+                if k in ("shm", "spill"):
+                    descs.append(("p", oid.binary(), size))
+                    continue
+            descs.append(("v", data))
+        self._finish_local(entry, descs,
+                           msg[3] if len(msg) > 3 else None, None,
+                           "done")
+        self._drain_local_queue()
+
+    def _finish_local(self, entry, descs, contained, err_bytes,
+                      disposition: str) -> None:
+        with self._sync_lock:
+            self._sync_batch.append(
+                ("done", entry["spec"].task_id.binary(), descs,
+                 contained, err_bytes, disposition))
+        self._sync_wake.set()
+
+    def _on_worker_gone(self, index: int) -> None:
+        """A local worker died/exited: hand its agent-leased tasks back
+        to the head (retry disposition — the head's TaskManager owns
+        the attempt budget) and credit its head-task debits."""
+        lost = [tid for tid, e in list(self._local_tasks.items())
+                if e["index"] == index]
+        for tid_bin in lost:
+            entry = self._local_tasks.pop(tid_bin, None)
+            if entry is None:
+                continue
+            self.store.unpin(entry["pins"])
+            with self._view_lock:
+                for k, v in entry["cu"].items():
+                    self._avail_cu[k] = self._avail_cu.get(k, 0) + v
+            self._finish_local(entry, None, None, None, "retry")
+        for tid_bin in [t for t, (_cu, i) in list(self._head_tasks.items())
+                        if i == index]:
+            self._credit_head_task(tid_bin)
+
+    def _try_local_get(self, index: int, msg) -> bool:
+        """Serve a worker's get entirely from the local arena when
+        every requested object is plasma-resident HERE (the data is
+        already on this machine — a head round-trip would only copy
+        the descriptor path, not the bytes)."""
+        from .object_store import PLASMA_KINDS
+        oids = [ObjectID(b) for b in msg[1]]
+        if not oids:
+            return False
+        descs, pins = [], []
+        try:
+            for o in oids:
+                kind, _ = self.store.plasma_info(o)
+                if kind not in PLASMA_KINDS:
+                    self.store.unpin(pins)
+                    return False
+                desc = self.store.descriptor_of(o)
+                if desc[0] != "s":
+                    self.store.unpin(pins)
+                    return False
+                pins.append((o, desc[1]))
+                descs.append(desc)
+        except KeyError:
+            self.store.unpin(pins)
+            return False
+        with self._pin_lock:
+            self._get_pins.setdefault(index, deque()).append(pins)
+        if not self._send_to_worker(index,
+                                    ("get_reply_x", "ok", descs)):
+            with self._pin_lock:
+                dq = self._get_pins.get(index)
+                if dq and dq[-1] is pins:
+                    dq.pop()
+            self.store.unpin(pins)
+            return False
+        return True
+
+    # -- batched head sync ---------------------------------------------------
+    def _sync_loop(self) -> None:
+        """Ship started/done/load batches to the head: amortized (a
+        2 ms coalescing window after the first append) so a fan-out of
+        N local leases costs O(1) head frames, not O(N)."""
+        import time
+        while not self._stopping and not self._stop_event.is_set():
+            if not self._sync_wake.wait(timeout=0.5):
+                continue
+            time.sleep(0.002)           # coalesce a burst
+            self._sync_wake.clear()
+            # stale local leases (queued past the lease timeout behind
+            # blocked/busy workers) spill back to the head for global
+            # placement — the raylet's stale-lease spillback, agent-side
+            from ..common.config import get_config
+            stale_after = get_config().worker_lease_timeout_ms / 1000.0
+            now = time.monotonic()
+            stale = []
+            with self._view_lock:
+                while self._local_queue and \
+                        now - self._local_queue[0]["enq"] > stale_after:
+                    stale.append(self._local_queue.popleft())
+            for e in stale:
+                self._finish_local(e, None, None, None, "requeue")
+            with self._sync_lock:
+                batch = self._sync_batch
+                self._sync_batch = []
+            if not batch:
+                continue
+            load: dict[str, int] = {}
+            for e in list(self._local_tasks.values()):
+                for k, v in e["cu"].items():
+                    load[k] = load.get(k, 0) + v
+            try:
+                self._head.call("agent_sync", self.agent_id, batch,
+                                load)
+            except Exception:   # noqa: BLE001 — head gone: the
+                pass            # on_close/reconnect flow owns cleanup
+
     # -- worker->head pump ---------------------------------------------------
     def _pump(self, index: int, conn, epoch: int = 0) -> None:
         while True:
@@ -472,6 +1018,8 @@ class NodeAgent:
                 msg = self._rewrite_up(index, msg)
             except Exception:   # noqa: BLE001 — surgery must not drop
                 pass            # the frame; forward as-is
+            if msg is None:
+                continue        # fully handled locally (autonomy path)
             try:
                 self._head.call("agent_frame", self.agent_id, index, msg)
             except Exception:   # noqa: BLE001 — head gone: nothing to
@@ -480,12 +1028,14 @@ class NodeAgent:
         if self._epoch != epoch:
             return          # stale: do NOT EOF the new pool's worker
         self._release_index_pins(index)
+        self._on_worker_gone(index)
         try:
             self._head.call("agent_eof", self.agent_id, index)
         except Exception:       # noqa: BLE001
             pass
         with self._lock:
             self._workers.pop(index, None)
+            self._w_state.pop(index, None)
 
 
 # ---------------------------------------------------------------------------
@@ -607,6 +1157,15 @@ class AgentSpawner:
         except Exception:       # noqa: BLE001 — best-effort, like SIGKILL
             pass                # on an already-dead pid
 
+    def set_policy(self, policy: dict) -> None:
+        """Push an autonomy-policy update (job-env gating) to the
+        agent; best-effort — a dropped push only disables/keeps the
+        fast path until the next one."""
+        try:
+            self._client.call("a_policy", policy, timeout=10.0)
+        except Exception:       # noqa: BLE001
+            pass
+
     def feed_frame(self, index: int, msg) -> None:
         with self._lock:
             conn = self._conns.get(index)
@@ -659,16 +1218,44 @@ class AgentHub:
             "agent_frame": self.frame,
             "agent_eof": self.eof,
             "agent_bye": self.bye,
+            "agent_sync": self.sync,
+            "agent_fn": self.fn_bytes,
         }
+
+    def fn_bytes(self, fn_id: str):
+        """Serve a function's bytes from the head registry (agents
+        fetch once per function for their autonomous dispatch)."""
+        return self._cluster.fn_registry.get(fn_id)
 
     def attach(self, server) -> None:
         for name, fn in self.handlers().items():
             server.add_handler(name, fn)
         self._cluster.plane.attach(server)
+        # job-env changes gate the agents' env-blind fast path
+        self._cluster.on_job_env_change = self._push_policy_all
+
+    def _push_policy_all(self, env) -> None:
+        """Fire the policy push on a side thread: the caller may hold
+        head-level locks (HeadNode._connect), and an unreachable agent
+        must not stall it for the RPC timeout."""
+        with self._lock:
+            spawners = [e[0] for e in self._agents.values()]
+        policy = {"fast_path": not bool(env)}
+
+        def run() -> None:
+            for sp in spawners:
+                sp.set_policy(policy)
+        threading.Thread(target=run, daemon=True,
+                         name="agent-policy-push").start()
 
     def register(self, agent_id: str, agent_address: str,
                  resources: dict | None, num_workers: int,
-                 labels: dict | None, plane: bool = False) -> str:
+                 labels: dict | None, plane: bool = True):
+        if not plane:
+            raise ValueError(
+                "relay-only agents are no longer supported: every "
+                "NodeAgent serves an object plane (one data-plane "
+                "code path)")
         # the disconnect hook is live from the START — an agent dying
         # mid-registration must still tear down whatever exists by then
         spawner = AgentSpawner(
@@ -683,7 +1270,7 @@ class AgentHub:
             node_id = self._cluster.add_remote_node(
                 resources=resources, num_workers=num_workers,
                 spawner=spawner, labels=labels,
-                plane_address=agent_address if plane else None)
+                plane_address=agent_address)
         except BaseException:
             with self._lock:
                 self._agents.pop(agent_id, None)
@@ -704,12 +1291,125 @@ class AgentHub:
                 pass
             raise ConnectionError("agent disconnected during "
                                   "registration")
-        return node_id.hex()
+        return {"node_id": node_id.hex(),
+                "resources": resources or dict(DEFAULT_NODE_RESOURCES),
+                "fast_path": not bool(self._cluster.job_runtime_env)}
 
     def frame(self, agent_id: str, index: int, msg) -> None:
         entry = self._agents.get(agent_id)
         if entry is not None:
             entry[0].feed_frame(index, msg)
+
+    # -- autonomy sync (ordered refs/started/done batch from an agent) ------
+    def sync(self, agent_id: str, batch: list, load: dict) -> bool:
+        """Fold an agent's autonomous-dispatch batch into the head's
+        authority, IN ORDER: ref-count events, started specs
+        (ownership, lineage), done results (seal + complete +
+        reconcile), then the node's live local load.  The per-lease
+        head cost is this amortized call — the lease itself never
+        touched the head."""
+        entry = self._agents.get(agent_id)
+        if entry is None or entry[1] is None:
+            return False
+        node_id = entry[1]
+        cluster = self._cluster
+        row = cluster.crm.row_of(node_id)
+        raylet = cluster.raylets.get(row) if row is not None else None
+        if raylet is None:
+            return False
+        for item in batch:
+            kind = item[0]
+            if kind == "refs":
+                cluster.ref_counter.apply_batch(
+                    item[2], ("w", row, item[1]))
+            elif kind == "started":
+                self._sync_started(cluster, raylet, row, item[1],
+                                   item[2],
+                                   item[3] if len(item) > 3 else None,
+                                   item[4] if len(item) > 4 else None)
+            elif kind == "done":
+                self._sync_done(cluster, raylet, row, item)
+        raylet.agent_local_cu = dict(load) if load else None
+        raylet._notify_dirty()
+        return True
+
+    def _sync_started(self, cluster, raylet, row: int,
+                      spec_bytes: bytes, submitter: int,
+                      fn_id: str | None = None,
+                      fn_bytes: bytes | None = None) -> None:
+        from ..common.ids import ObjectID as _OID
+        from .serialization import deserialize
+        tm = cluster.task_manager
+        if fn_bytes is not None and fn_id is not None:
+            # worker-defined fn whose submit frame never reached the
+            # head: the registry must resolve fn_id for retries and
+            # lineage reconstruction
+            cluster.fn_registry.setdefault(fn_id, fn_bytes)
+        spec = deserialize(spec_bytes)
+        if tm.get(spec.task_id) is not None:
+            return              # duplicate (reconnect replay)
+        rec = tm.register(spec)
+        rec.lineage_bytes = len(spec_bytes) + 256
+        holder = ("w", row, submitter)
+        for i in range(max(spec.num_returns, 0)):
+            cluster.ref_counter.set_owner(
+                _OID.for_task_return(spec.task_id, i + 1), holder)
+        raylet.agent_inflight[spec.task_id] = rec
+
+    def _sync_done(self, cluster, raylet, row: int, item) -> None:
+        from ..common.ids import TaskID
+        from .serialization import RayTaskError, WorkerCrashedError, \
+            deserialize
+        _kind, tid_bin, descs, contained, err_bytes, disposition = item
+        tm = cluster.task_manager
+        tid = TaskID(tid_bin)
+        rec = raylet.agent_inflight.pop(tid, None)
+        if rec is None:
+            rec = tm.get(tid)
+        if rec is None or rec.done:
+            return
+        if disposition == "requeue":
+            # never ran on the agent (stale lease, worker vanished
+            # pre-exec, arg freed): re-enter global scheduling without
+            # consuming a retry attempt
+            raylet.submit_existing(rec)
+            return
+        if disposition == "retry":
+            # local worker died under the task: the head owns the
+            # attempt budget — resubmit through normal scheduling
+            if tm.should_retry(tid):
+                raylet.submit_existing(rec)
+            else:
+                err = RayTaskError(
+                    rec.spec.function_descriptor, "worker died",
+                    WorkerCrashedError(
+                        "agent-local worker died executing "
+                        f"{rec.spec.function_descriptor}"))
+                raylet._seal_error_returns(rec, err)
+                tm.complete(tid)
+            return
+        if err_bytes is not None:
+            raylet._seal_error_returns(rec, deserialize(err_bytes))
+            tm.complete(tid)
+            return
+        raylet._seal_contained(rec, contained)
+        head_row = cluster.head().row
+        for oid, d in zip(rec.return_ids, descs or ()):
+            if oid in rec.dead_returns:
+                if d[0] == "p" and raylet.plane_address is not None:
+                    cluster.plane.free_on(raylet.plane_address, [oid])
+                continue
+            if d[0] == "p":
+                cluster.directory.add_location(oid, row)
+                cluster.store.put_remote(oid, d[2])
+            else:
+                cluster.seal_serialized(oid, d[1], head_row)
+        tm.complete(tid)
+        # close the fire-and-forget race: a return whose refs all died
+        # before this registration reclaims now instead of leaking
+        # (reference_counter.reconcile docstring)
+        for oid in rec.return_ids:
+            cluster.ref_counter.reconcile(oid)
 
     def eof(self, agent_id: str, index: int) -> None:
         entry = self._agents.get(agent_id)

@@ -101,11 +101,12 @@ class Raylet:
         self.node_id = node_id
         self.cluster = cluster
         # remote-node raylet: workers live on another machine (node
-        # agent) and share no arena with the head.  With a plane address
-        # the agent runs its own arena: plasma args/results move over
-        # the object plane and frames carry by-REFERENCE descriptors the
-        # agent resolves against its local store; without one (legacy),
-        # every payload ships in-band through the head
+        # agent) and share no arena with the head.  The agent always
+        # runs its own arena (plane_address mandatory for remote
+        # nodes): plasma args/results move over the object plane and
+        # frames carry by-REFERENCE descriptors the agent resolves
+        # against its local store.  inline_objects marks the no-shared-
+        # arena transport (small values still ship in-band in frames)
         self.inline_objects = inline_objects
         self.plane_address = plane_address
         self.remote_plane = plane_address is not None
@@ -141,6 +142,12 @@ class Raylet:
         self._stopped = False
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
+        # agent-autonomous dispatch bookkeeping (plane agents only):
+        # tasks the AGENT leased locally without a head round-trip —
+        # registered here via the batched agent_sync so lineage,
+        # ownership, and node-death recovery still work
+        self.agent_inflight: dict = {}          # TaskID -> TaskRecord
+        self.agent_local_cu: dict | None = None  # live local demand
         arena = getattr(cluster, "arena", None)
         self.pool = WorkerPool(
             num_workers, self._on_worker_message, self._on_worker_death,
@@ -207,10 +214,12 @@ class Raylet:
         return out
 
     def is_idle(self) -> bool:
-        """No queued, waiting, placed, or running work on this node."""
+        """No queued, waiting, placed, or running work on this node
+        (including tasks its agent leased autonomously)."""
         with self._cv:
             return not (self._queue or self._local_queue or self._running
-                        or self._waiting or self._pull_pending)
+                        or self._waiting or self._pull_pending
+                        or self.agent_inflight)
 
     def queue_stats(self) -> dict:
         """Live depths + recent scheduling-round durations (metrics)."""
@@ -462,11 +471,20 @@ class Raylet:
 
     def _schedule_rows_host_subgrouped(self, specs, prefs,
                                        avoids) -> list[int]:
-        """Host twin of the device subgroup path: per-task policy over
-        the SAME (class, pref, avoid) subgroups in first-appearance
-        order — so small rounds and device rounds evolve ``avail``
-        identically (the batch-size threshold stays unobservable) and
-        the locality probe is never run twice."""
+        """Host twin of the device subgroup path: the SAME
+        (class, pref, avoid) subgroups in first-appearance order — so
+        small rounds and device rounds evolve ``avail`` identically
+        (the batch-size threshold stays unobservable) and the locality
+        probe is never run twice.
+
+        Multi-task subgroups place through the pure-numpy water-fill
+        (``ops.hybrid_kernel.schedule_group_host`` — one vectorized
+        call per subgroup, bit-identical to the sequential policy by
+        the parity contract) instead of per-task ``compute_keys``
+        loops: the per-task path's numpy overhead on the scheduling
+        thread was the dominant GIL cost of tiny-task rounds.
+        Singletons and top-k sampling rounds keep the per-task policy
+        (the host sampler draws per task)."""
         snapshot = self._effective_snapshot()
         n_rows = snapshot.node_mask.shape[0]
         by_sub: dict[tuple, list[int]] = {}
@@ -475,9 +493,35 @@ class Raylet:
                    prefs[t] if prefs[t] is not None else -1, avoids[t])
             by_sub.setdefault(key, []).append(t)
         rows = [-1] * len(specs)
+        vec_ok = get_config().scheduler_top_k_fraction == 0
+        if vec_ok:
+            from ..ops.hybrid_kernel import schedule_group_host
+            from ..scheduling.contract import threshold_fp
+            thr = threshold_fp(None)
         for (cls_key, pref, avoid), idxs in by_sub.items():
             req = specs[idxs[0]].resources.dense(
                 self.crm.resource_index, snapshot.totals.shape[1])
+            if vec_ok and len(idxs) > 1:
+                gmask = None
+                if avoid and 0 <= self.row < n_rows:
+                    gmask = np.ones(n_rows, dtype=bool)
+                    gmask[self.row] = False
+                # avoid wins over pref, matching the per-task branch
+                # below and _schedule_rows' construction (avoid tasks
+                # get pref None there — the local data node is exactly
+                # what starved them)
+                counts_row, new_avail = schedule_group_host(
+                    snapshot.avail, snapshot.totals, snapshot.node_mask,
+                    req, len(idxs), gmask, thr,
+                    pref_row=-1 if avoid else int(pref))
+                snapshot.avail[:] = new_avail       # sequential carry
+                slots = np.repeat(
+                    np.concatenate([np.arange(n_rows, dtype=np.int32),
+                                    np.array([-1], dtype=np.int32)]),
+                    counts_row)
+                for t, r in zip(idxs, slots):
+                    rows[t] = int(r)
+                continue
             for t in idxs:
                 if avoid:
                     opts = SchedulingOptions(avoid_local_node=True,
@@ -684,12 +728,27 @@ class Raylet:
         return counts
 
     def _effective_snapshot(self):
-        """CRM snapshot minus every node's planned-but-undispatched load,
-        so placement rounds do not over-assign nodes whose local queues
-        are already deep."""
+        """CRM snapshot minus every node's planned-but-undispatched load
+        AND its agent-locally-running load (tasks an autonomous agent
+        leased without the head — reported on the batched agent_sync),
+        so placement rounds do not over-assign nodes whose queues or
+        local leases are already deep."""
         snapshot = self.crm.snapshot()
         for row, raylet in list(self.cluster.raylets.items()):
             planned = raylet.planned_snapshot()
+            local = raylet.agent_local_cu
+            if local:
+                vec = ResourceRequest.from_cu_dict(local).dense(
+                    self.crm.resource_index,
+                    snapshot.avail.shape[1]).astype(np.int64)
+                if planned is None:
+                    planned = vec
+                else:
+                    n = max(planned.shape[0], vec.shape[0])
+                    merged = np.zeros(n, dtype=np.int64)
+                    merged[:planned.shape[0]] += planned
+                    merged[:vec.shape[0]] += vec
+                    planned = merged
             if planned is None:
                 continue
             w = min(snapshot.avail.shape[1], planned.shape[0])
@@ -1057,8 +1116,17 @@ class Raylet:
         with self._cv:
             self._running[spec.task_id.binary()] = (spec.task_id, worker,
                                                     pinned)
-        if not worker.send(("exec", spec.task_id.binary(), fn_id, payload,
-                            spec.trace_ctx, extern)):
+        # plane agents get the task's demand vector appended (7th
+        # element, stripped before the worker sees the frame): the
+        # agent maintains a local availability view for its autonomous
+        # dispatch fast path from exactly these observations
+        if self.remote_plane:
+            frame = ("exec", spec.task_id.binary(), fn_id, payload,
+                     spec.trace_ctx, extern, spec.resources.cu())
+        else:
+            frame = ("exec", spec.task_id.binary(), fn_id, payload,
+                     spec.trace_ctx, extern)
+        if not worker.send(frame):
             with self._cv:
                 entry = self._running.pop(spec.task_id.binary(), None)
             if entry is not None:
@@ -1268,6 +1336,46 @@ class Raylet:
             self._recall_assigned(worker)
             return False
 
+    def _quick_dispatch_from_queue(self, worker: WorkerHandle) -> bool:
+        """Result-chained dispatch (runs on the worker's reader
+        thread): hand the just-freed worker the OLDEST placed
+        default-env task whose resources fit, without waiting for a
+        scheduling-loop wake.  Conservative by design — any
+        complication (env task at the head, in-flight arg pulls,
+        stopped node, resource miss) falls back to the event loop,
+        which retains full responsibility for fairness across classes
+        and env/pull handling."""
+        if worker.dead or worker.blocked or worker.env_key is not None \
+                or getattr(worker, "dedicated", False):
+            return False
+        with self._cv:
+            if self._stopped or not self._local_queue:
+                return False
+            # oldest class head (same order _drain_local visits)
+            pick, oldest = None, float("inf")
+            for key in self._local_queue.classes():
+                for tid in self._local_queue.bucket(key):
+                    t0 = self._local_since.get(tid, float("inf"))
+                    if t0 < oldest:
+                        oldest, pick = t0, tid
+                    break               # head of this class only
+            if pick is None or pick in self._pull_pending:
+                return False
+            rec = self.task_manager.get(pick)
+            if rec is None or rec.done or rec.spec.runtime_env:
+                return False
+            if not self.crm.subtract(self.row, rec.spec.resources):
+                return False
+            try:
+                self._local_queue.remove(pick)
+            except ValueError:
+                self.crm.add_back(self.row, rec.spec.resources)
+                return False
+            self._local_since.pop(pick, None)
+            self._env_miss_since.pop(pick, None)
+            self._planned_add(rec.spec.resources, -1)
+        return self._dispatch(worker, rec)
+
     def _recall_assigned(self, worker: WorkerHandle,
                          to_global: bool = False,
                          avoid_local: bool = False) -> None:
@@ -1436,8 +1544,12 @@ class Raylet:
                 self.task_manager.complete(task_id)
                 self.crm.add_back(self.row, rec.spec.resources)
             # pipelined lease: ship the next committed task from THIS
-            # reader thread before anything else can steal the worker
-            if not self._dispatch_next_assigned(worker):
+            # reader thread before anything else can steal the worker;
+            # with no committed entry, chain straight into the oldest
+            # queued task that fits (skips the event-loop wake — the
+            # tiny-task hot path's dominant fixed cost)
+            if not self._dispatch_next_assigned(worker) and \
+                    not self._quick_dispatch_from_queue(worker):
                 self.pool.release(worker)
             self._notify_dirty()
         elif kind == "get":
@@ -1975,6 +2087,25 @@ class Raylet:
             self.cluster.ref_counter.holder_gone(self._holder_of(w))
         for task_id in queued:
             fallback.enqueue_forwarded(task_id)
+        # tasks the agent leased autonomously die with the node too:
+        # their done-sync will never arrive, so retry or fail them NOW
+        # (exactly the running-task semantics below)
+        agent_tasks = list(self.agent_inflight.values())
+        self.agent_inflight.clear()
+        self.agent_local_cu = None
+        for rec in agent_tasks:
+            task_id = rec.spec.task_id
+            if rec.done:
+                continue
+            if self.task_manager.should_retry(task_id):
+                fallback.enqueue_forwarded(task_id)
+            else:
+                err = RayTaskError(
+                    rec.spec.function_descriptor, "node removed",
+                    WorkerCrashedError("node died with agent-leased "
+                                       "task running"))
+                self._seal_error_returns(rec, err)
+                self.task_manager.complete(task_id)
         for _bin, (task_id, _w, pinned) in running:
             self.store.unpin(pinned)
             if self.task_manager.should_retry(task_id):

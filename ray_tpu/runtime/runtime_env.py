@@ -84,6 +84,37 @@ def merge_runtime_env(job_env: dict | None,
     return merged
 
 
+def _python_pin_satisfied(pin: str) -> bool:
+    """Does the RUNNING interpreter satisfy a conda ``python`` pin?
+
+    Handles the operator properly (``python>=3.8`` on 3.12 passes;
+    ``python=3.1`` on 3.12 fails — component comparison, not string
+    prefix).  Unparseable pins pass (don't invent failures for exotic
+    conda syntax this deployment can't evaluate)."""
+    import re
+    import sys
+    m = re.match(r"python\s*(>=|<=|==|!=|~=|=|>|<)?\s*([0-9.]+)?\s*$",
+                 pin)
+    if m is None or not m.group(2):
+        return True
+    op = m.group(1) or "="
+    want = tuple(int(p) for p in m.group(2).strip(".").split("."))
+    have = tuple(sys.version_info[:3])
+    trunc = have[:len(want)]            # compare at the pin's precision
+    if op in ("=", "==", "~="):
+        # conda '=' / '~=' prefix semantics: 3.12.x matches '3.12'
+        return trunc == want
+    if op == "!=":
+        return trunc != want
+    if op == ">=":
+        return trunc >= want
+    if op == "<=":
+        return trunc <= want
+    if op == ">":
+        return trunc > want
+    return trunc < want                 # op == "<"
+
+
 class RuntimeEnvManager:
     def __init__(self, session_dir: str):
         self._root = os.path.join(session_dir, "runtime_resources")
@@ -189,9 +220,14 @@ class RuntimeEnvManager:
     @staticmethod
     def _pip_requirements(env: dict) -> list[str]:
         """Requirement strings in PIP syntax.  Conda dependencies
-        translate: interpreter pins (``python=3.x``) drop, and conda's
-        single-``=`` version pins become pip ``==`` pins."""
+        translate: conda's single-``=`` version pins become pip ``==``
+        pins; interpreter pins (``python=3.x``) are VALIDATED against
+        the running interpreter (this deployment cannot materialize a
+        different Python — no conda binary, no egress; see the README
+        capability matrix) and fail staging loudly on mismatch rather
+        than silently dropping."""
         import re
+        import sys
         reqs = list(env.get("pip") or [])
         conda = env.get("conda")
         if isinstance(conda, dict):
@@ -200,6 +236,15 @@ class RuntimeEnvManager:
                     continue
                 name = re.split(r"[=<>!~\[;\s]", d.strip(), 1)[0]
                 if name == "python":
+                    if not _python_pin_satisfied(d.strip()):
+                        raise RuntimeEnvSetupError(
+                            f"conda interpreter pin {d!r} does not "
+                            f"match the running Python "
+                            f"{'.'.join(map(str, sys.version_info[:3]))}"
+                            " — this deployment provisions conda specs "
+                            "through the pip wheelhouse and cannot "
+                            "install a different interpreter (no conda "
+                            "binary, no egress)")
                     continue
                 # name=1.2 (conda) -> name==1.2 (pip); leave ==/>=/etc
                 reqs.append(re.sub(r"(?<![=<>!~])=(?!=)", "==", d))

@@ -307,6 +307,15 @@ class DeploymentHandle:
 
 @dataclass
 class Application:
+    """A bound deployment node.  ``bind`` composes DECLARATIVELY:
+    passing one deployment's ``bind()`` result as an argument to
+    another's makes a deployment GRAPH — ``serve.run`` materializes
+    the whole DAG depth-first, replacing each nested node with its
+    live ``DeploymentHandle`` (reference: Serve's ``bind`` DAG API,
+    ``python/ray/serve/``, SURVEY.md §1 layer 14; mount empty).
+    A node shared by several parents (diamond fan-in) materializes
+    once and its replicas are shared."""
+
     deployment: "Deployment"
     args: tuple
     kwargs: dict
@@ -382,6 +391,9 @@ class _Running:
     handle: DeploymentHandle
     deployment: Deployment = None
     route_prefix: str | None = None
+    # child controllers of a deployment graph (teardown order: root
+    # first — it is the only one the ingress/user routes into)
+    child_controllers: list = field(default_factory=list)
 
 
 _apps: dict[str, _Running] = {}
@@ -422,6 +434,22 @@ def http_address() -> str | None:
         return _ingress.address if _ingress is not None else None
 
 
+def _substitute_bound(value, build):
+    """Replace Application nodes with live handles inside an argument,
+    one container level deep (lists/tuples/dicts of bound nodes are
+    common graph shapes)."""
+    if isinstance(value, Application):
+        return build(value)
+    if isinstance(value, (list, tuple)):
+        out = [build(v) if isinstance(v, Application) else v
+               for v in value]
+        return type(value)(out)
+    if isinstance(value, dict):
+        return {k: build(v) if isinstance(v, Application) else v
+                for k, v in value.items()}
+    return value
+
+
 def run(app: Application, *, name: str = "default",
         route_prefix: str | None = None) -> DeploymentHandle:
     import ray_tpu
@@ -431,15 +459,40 @@ def run(app: Application, *, name: str = "default",
         # leak a live replica set nothing can reach or tear down
         from .http_proxy import _norm_prefix
         route_prefix = _norm_prefix(route_prefix)
+    # materialize the bound DAG depth-first: nested Application args
+    # become live DeploymentHandles (shared nodes materialize once)
+    materialized: dict[int, DeploymentHandle] = {}
+    building: set[int] = set()
+    controllers: list = []
+
+    def build(a: Application) -> DeploymentHandle:
+        got = materialized.get(id(a))
+        if got is not None:
+            return got
+        if id(a) in building:
+            raise ValueError(
+                f"deployment graph cycle through {a.deployment.name!r}")
+        building.add(id(a))
+        d = a.deployment
+        b_args = tuple(_substitute_bound(x, build) for x in a.args)
+        b_kwargs = {k: _substitute_bound(v, build)
+                    for k, v in a.kwargs.items()}
+        controller_cls = ray_tpu.remote(_Controller)
+        ctl = controller_cls.remote(
+            serialize(d._target), serialize((b_args, b_kwargs)),
+            d._num_replicas, d._autoscaling, d._actor_options,
+            d._max_ongoing)
+        # materialize the replica set before handing the handle out
+        ray_tpu.get(ctl.num_replicas.remote(), timeout=60)
+        h = DeploymentHandle(ctl)
+        building.discard(id(a))
+        materialized[id(a)] = h
+        controllers.append(ctl)
+        return h
+
     dep = app.deployment
-    controller_cls = ray_tpu.remote(_Controller)
-    controller = controller_cls.remote(
-        serialize(dep._target), serialize((app.args, app.kwargs)),
-        dep._num_replicas, dep._autoscaling, dep._actor_options,
-        dep._max_ongoing)
-    # materialize the replica set before returning the handle
-    ray_tpu.get(controller.num_replicas.remote(), timeout=60)
-    handle = DeploymentHandle(controller)
+    handle = build(app)
+    controller = controllers.pop()      # the root's (built last)
     if route_prefix is not None:
         # a generator __call__ makes the HTTP route STREAMING: chunked
         # transfer of each yielded item (reference streaming responses)
@@ -452,7 +505,8 @@ def run(app: Application, *, name: str = "default",
                                     stream=http_stream)
     with _apps_lock:
         old = _apps.pop(name, None)
-        _apps[name] = _Running(controller, handle, dep, route_prefix)
+        _apps[name] = _Running(controller, handle, dep, route_prefix,
+                               controllers)
     if old is not None:
         ingress = _ingress_if_running()
         if old.route_prefix is not None and ingress is not None:
@@ -486,11 +540,15 @@ def status(name: str = "default") -> dict:
 
 def _teardown(running: _Running) -> None:
     import ray_tpu
-    try:
-        ray_tpu.get(running.controller.shutdown.remote(), timeout=30)
-        ray_tpu.kill(running.controller)
-    except Exception:   # noqa: BLE001 — already dead
-        pass
+    # root first (nothing routes into the children once it is gone),
+    # then the graph's children
+    for ctl in [running.controller] + \
+            list(reversed(running.child_controllers)):
+        try:
+            ray_tpu.get(ctl.shutdown.remote(), timeout=30)
+            ray_tpu.kill(ctl)
+        except Exception:   # noqa: BLE001 — already dead
+            pass
 
 
 def delete(name: str = "default") -> None:

@@ -201,3 +201,126 @@ class TestGroupByAndIO:
         for p in parts:
             rows.extend(json.load(open(_os.path.join(out, p))))
         assert sorted(rows) == [0, 1, 2, 3]
+
+
+class TestColumnBlocks:
+    """Binary columnar block format + adaptive streaming window
+    (VERDICT r04 next-step #9; upstream: Arrow blocks + block-size
+    metadata feeding the streaming executor's memory accounting)."""
+
+    def test_binary_roundtrip_bit_exact(self, tmp_path):
+        import numpy as np
+
+        from ray_tpu.data import ColumnBlock, read_block_file, \
+            write_block_file
+        rng = np.random.default_rng(3)
+        b = ColumnBlock({
+            "f32": rng.normal(size=(50, 4)).astype(np.float32),
+            "i64": rng.integers(-2**40, 2**40, size=50),
+            "u8": rng.integers(0, 255, size=(50, 2)).astype(np.uint8),
+            "bools": rng.random(50) > 0.5,
+        })
+        path = str(tmp_path / "b.rtb")
+        write_block_file(b, path)
+        back = read_block_file(path)
+        assert back == b
+        assert back.column("f32").dtype == np.float32
+        assert back.nbytes == b.nbytes
+        # no pickle in the file: magic + JSON header + raw buffers
+        raw = open(path, "rb").read()
+        assert raw[:4] == b"RTB1"
+
+    def test_pickle_crosses_as_binary(self):
+        import pickle
+
+        import numpy as np
+
+        from ray_tpu.data import ColumnBlock
+        b = ColumnBlock({"x": np.arange(10)})
+        assert pickle.loads(pickle.dumps(b)) == b
+
+    def test_row_pivots_and_transforms(self):
+        import numpy as np
+
+        from ray_tpu.data import ColumnBlock
+        rows = [{"a": i, "b": float(i) / 2} for i in range(8)]
+        b = ColumnBlock.from_rows(rows)
+        assert b.num_rows == 8
+        assert b.to_rows() == rows
+        assert b.select(["a"]).column_names == ["a"]
+        assert b.take(np.arange(3)).num_rows == 3
+        assert b.slice(2, 5).num_rows == 3
+
+    def test_object_dtype_refused(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from ray_tpu.data import ColumnBlock
+        b = ColumnBlock({"x": np.array(["a", {"d": 1}], dtype=object)})
+        with _pytest.raises(TypeError):
+            b.to_bytes()
+
+    def test_stream_block_files_roundtrip(self, tmp_path, driver):
+        import numpy as np
+
+        from ray_tpu import data
+        blocks = [data.ColumnBlock({"v": np.arange(20) + 20 * i})
+                  for i in range(6)]
+        data.write_blocks(blocks, str(tmp_path))
+        got = list(data.stream_block_files(str(tmp_path)).iter_blocks())
+        assert got == blocks
+        # columnar map_batches sees the ColumnBlock itself
+        sums = [int(b.column("v").sum()) for b in
+                data.stream_block_files(str(tmp_path)).iter_blocks()]
+        assert sums[0] == sum(__import__("builtins").range(20))
+
+
+class TestAdaptiveWindow:
+    def test_big_blocks_shrink_window_small_blocks_widen(self):
+        from ray_tpu.data.streaming import DataStream
+        s = DataStream(lambda: iter(()))        # adaptive by default
+        assert s._window is None
+        # budget 1MB: 512KB blocks -> window 2; 4KB blocks -> capped 32
+        s = s.target_bytes(1 << 20)
+        sizes_big = [512 * 1024] * 4
+        sizes_small = [4 * 1024] * 4
+        avg_big = sum(sizes_big) // len(sizes_big)
+        avg_small = sum(sizes_small) // len(sizes_small)
+        assert (1 << 20) // avg_big == 2
+        assert min(max((1 << 20) // avg_small, 1), 32) == 32
+
+    def test_peak_memory_scales_with_window_times_block(self, driver):
+        """The VERDICT #9 done-criterion: peak arena occupancy tracks
+        window x block-size, NOT dataset size, with the ADAPTIVE
+        window (big plasma blocks clamp it down)."""
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu import data
+        rt = ray_tpu.api._get_runtime()
+        store = rt.cluster.store
+        n_blocks = 120
+        block_bytes = 400_000       # plasma-routed
+
+        def make():
+            for i in range(n_blocks):
+                yield data.ColumnBlock(
+                    {"x": np.full(block_bytes // 8, i, np.int64)})
+
+        # budget of ~3 blocks: the adaptive window must clamp to <= 4
+        src = data.stream_blocks(make).target_bytes(3 * block_bytes)
+        peak = 0
+        count = 0
+        for block in src.map_batches(
+                lambda b: b if hasattr(b, "nbytes") else b).iter_blocks():
+            count += 1
+            _time.sleep(0.02)       # reclamation is asynchronous
+            peak = max(peak, store.stats()["arena_bytes_in_use"])
+        assert count == n_blocks
+        # adaptive window(<=4) + the source generator's own 16-item
+        # backpressure + async reclaim slack — NOT the 48MB the
+        # dataset totals (the bound is half the dataset; steady-state
+        # sits well under it and does not grow with n_blocks)
+        assert 0 < peak < 60 * block_bytes, peak
+        rt.cluster.ref_counter.flush()

@@ -17,6 +17,10 @@ from ray_tpu.scheduling import (ClusterState, group_requests,
 
 
 def run_both(state, group_reqs, group_counts, thr, group_masks=None):
+    """Oracle vs device kernel vs pure-numpy host twin — all three must
+    agree bit-for-bit (the host twin is the raylet's small-round
+    dispatch path, ``ops.hybrid_kernel.schedule_group_host``)."""
+    from ray_tpu.ops.hybrid_kernel import schedule_group_host
     st = state.copy()
     want = schedule_grouped_oracle(st, group_reqs, group_counts,
                                    spread_threshold=thr,
@@ -26,6 +30,16 @@ def run_both(state, group_reqs, group_counts, thr, group_masks=None):
         group_masks, spread_threshold=thr)
     np.testing.assert_array_equal(got, want, err_msg="placement counts")
     np.testing.assert_array_equal(new_avail, st.avail, err_msg="avail")
+    av = np.asarray(state.avail, np.int64)
+    tfp = threshold_fp(thr)
+    for g in range(group_reqs.shape[0]):
+        row, av = schedule_group_host(
+            av, state.totals, state.node_mask, group_reqs[g],
+            int(group_counts[g]),
+            None if group_masks is None else group_masks[g], tfp)
+        np.testing.assert_array_equal(row, want[g],
+                                      err_msg=f"host twin group {g}")
+    np.testing.assert_array_equal(av, st.avail, err_msg="host twin avail")
     return got
 
 
@@ -177,3 +191,30 @@ def test_full_scale_parity_1k_nodes_64_classes_1m_tasks():
     for thr in (0.0, 1.01):
         run_both(ClusterState(totals, avail, node_mask), reqs,
                  counts, thr)
+
+
+def test_host_twin_pref_row_matches_localized_kernel():
+    """The host twin's soft-locality path (pref_row) vs the device
+    localized kernel — bit-identical (the raylet's locality-biased
+    small rounds take the host twin)."""
+    from ray_tpu.ops.hybrid_kernel import schedule_group_host
+    from ray_tpu.ops.locality_kernel import schedule_grouped_localized_np
+    rng = np.random.default_rng(11)
+    for trial in range(12):
+        n, r = 12, 3
+        totals = rng.integers(0, 2000, size=(n, r)).astype(np.int32)
+        avail = (totals * rng.random((n, r))).astype(np.int32)
+        mask = rng.random(n) > 0.1
+        req = rng.integers(0, 500, size=r).astype(np.int32)
+        cnt = int(rng.integers(0, 30))
+        pref = int(rng.integers(0, n))
+        thr = int(rng.choice([0, 4096, 2 ** 13]))
+        row, av = schedule_group_host(
+            avail.astype(np.int64), totals, mask, req, cnt, None, thr,
+            pref_row=pref)
+        dev, dav = schedule_grouped_localized_np(
+            totals, avail, mask, req[None],
+            np.array([cnt], np.int32), np.array([pref], np.int32),
+            thr_fp=thr)
+        np.testing.assert_array_equal(row, dev[0], err_msg=str(trial))
+        np.testing.assert_array_equal(av, dav, err_msg=str(trial))

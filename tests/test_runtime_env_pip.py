@@ -117,3 +117,29 @@ class TestPipProvisioning:
         h = Holder.remote()
         assert ray_tpu.get(h.get.remote(), timeout=120) == 42
         ray_tpu.kill(h)
+
+    def test_conda_python_pin_mismatch_fails_loudly(self, driver):
+        """A conda interpreter pin this deployment cannot satisfy must
+        fail staging (not silently drop): no conda binary, no egress —
+        see the README capability-matrix descope."""
+        @ray_tpu.remote(runtime_env={"conda": {
+            "dependencies": ["python=2.7", f"{PKG}=1.0.0"]}})
+        def doomed():
+            return 1
+
+        with pytest.raises(RuntimeEnvSetupError):
+            ray_tpu.get(doomed.remote(), timeout=120)
+
+    def test_conda_spec_provisions_via_wheelhouse(self, driver):
+        """Conda python-level deps really provision (offline, through
+        the pip wheelhouse path); a matching interpreter pin passes."""
+        import sys
+        pin = "%d.%d" % sys.version_info[:2]
+
+        @ray_tpu.remote(runtime_env={"conda": {
+            "dependencies": [f"python={pin}", f"{PKG}=1.0.0"]}})
+        def use_pkg():
+            import rtwheel_demo
+            return rtwheel_demo.VERSION
+
+        assert ray_tpu.get(use_pkg.remote(), timeout=120) == "1.0.0"
