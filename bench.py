@@ -237,6 +237,10 @@ def main():
         # rtt_control_ms is tunnel congestion, not a code regression.
         "rtt_control_ms": rtt_ms,
         "compute_only_ms": round(compute_ms, 3),
+        # control-normalized headline: p50 minus the measured tunnel
+        # noise floor — THIS is the number to compare across rounds
+        # (r01-r03 drift attribution, VERDICT r04 next-step #1)
+        "p50_minus_rtt_ms": round(max(p50 - rtt_ms, 0.0), 3),
         "plane_transfer_mbps": measure_plane_throughput(),
     }))
 

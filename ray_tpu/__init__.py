@@ -31,7 +31,7 @@ from .common import (Config, NodeResources, ResourceRequest, get_config)
 _API_NAMES = ("init", "shutdown", "is_initialized", "remote", "get", "put",
               "wait", "cancel", "kill", "get_actor",
               "available_resources", "cluster_resources", "nodes",
-              "timeline")
+              "timeline", "worker_stacks")
 
 
 def __getattr__(name):

@@ -594,6 +594,19 @@ def timeline(filename: str | None = None):
     return events.timeline()
 
 
+def worker_stacks(node_row: int | None = None,
+                  timeout: float = 5.0) -> dict:
+    """What is every worker doing RIGHT NOW: {'row:index': all-thread
+    stack text}.  Workers reply from their reader thread, so one
+    wedged in user code still reports (the dashboard's py-spy
+    integration upstream — SURVEY §5.1(c); mount empty)."""
+    rt = _get_runtime()
+    if not hasattr(rt, "cluster"):      # client mode: ask the head
+        return rt.worker_stacks(node_row, timeout)
+    got = rt.cluster.dump_worker_stacks(row=node_row, timeout=timeout)
+    return {f"{r}:{i}": text for (r, i), text in got.items()}
+
+
 def nodes() -> list[dict]:
     rt = _get_runtime()
     if not hasattr(rt, "crm"):          # client mode: ask the head

@@ -151,6 +151,7 @@ class Cluster:
             self.dashboard = Dashboard(self, dash_port,
                                        host=get_config().dashboard_host)
         self._head_row: int | None = None
+        self._stack_waits: dict[str, tuple] = {}    # live stack dumps
 
     def _reclaim_object(self, oid) -> None:
         """Refcount hit zero cluster-wide: free the object everywhere and
@@ -165,6 +166,49 @@ class Cluster:
             if addr is not None:
                 self.plane.free_on(addr, [oid])
         self.task_manager.on_return_reclaimed(oid)
+
+    # -- live worker stack sampling (SURVEY §5.1(c): the dashboard's
+    # py-spy integration, rebuilt on the worker reader thread) ---------------
+    def dump_worker_stacks(self, row: int | None = None,
+                           timeout: float = 5.0) -> dict:
+        """Ask every live worker (one node's with ``row``) what it is
+        doing RIGHT NOW: {(row, worker_index): all-thread stack text}.
+        Workers answer from their reader thread, so one wedged in user
+        code still reports — that wedge is exactly what this shows."""
+        import uuid
+        req = uuid.uuid4().hex
+        ev = threading.Event()
+        out: dict = {}
+        expected = [0]
+        self._stack_waits[req] = (ev, out, expected)
+        try:
+            with self._lock:
+                targets = [(r, ry) for r, ry in self.raylets.items()
+                           if row is None or r == row]
+            sent = 0
+            for r, raylet in targets:
+                with raylet.pool._lock:
+                    workers = list(raylet.pool._workers)
+                for w in workers:
+                    if not w.dead and w.ready and \
+                            w.send(("dump_stacks", req)):
+                        sent += 1
+            expected[0] = sent
+            if sent and len(out) < sent:
+                ev.wait(timeout)
+            return dict(out)
+        finally:
+            self._stack_waits.pop(req, None)
+
+    def _on_stacks_reply(self, req: str, row: int, index: int,
+                         text: str) -> None:
+        entry = self._stack_waits.get(req)
+        if entry is None:
+            return          # late reply after timeout: drop
+        ev, out, expected = entry
+        out[(row, index)] = text
+        if expected[0] and len(out) >= expected[0]:
+            ev.set()
 
     def set_job_runtime_env(self, env: dict | None) -> None:
         """Install the job-level default runtime_env and notify any

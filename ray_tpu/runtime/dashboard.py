@@ -83,6 +83,10 @@ class Dashboard(BackgroundHTTPServer):
             return state.list_placement_groups()
         if name == "timeline":
             return self._cluster.events.timeline()
+        if name == "stacks":
+            # live all-thread stacks of every worker (py-spy analogue)
+            got = self._cluster.dump_worker_stacks(timeout=5.0)
+            return {f"{r}:{i}": text for (r, i), text in got.items()}
         if name == "jobs":
             return self._jobs.list() if self._jobs is not None else []
         return None

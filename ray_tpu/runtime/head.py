@@ -146,6 +146,7 @@ class HeadNode:
             "timeline": self._timeline,
             "state_list": self._state_list,
             "memory": self._memory,
+            "worker_stacks": self._worker_stacks,
             "job_submit": self.jobs.submit,
             "job_status": self.jobs.status,
             "job_list": self.jobs.list,
@@ -157,6 +158,14 @@ class HeadNode:
     # -- client-mode surface -------------------------------------------------
     def _ping(self) -> dict:
         return {"ok": True, "session_dir": self._rt.cluster.session_dir}
+
+    def _worker_stacks(self, row: int | None = None,
+                       timeout: float = 5.0) -> dict:
+        """Live all-thread stacks of every worker (py-spy analogue —
+        SURVEY §5.1(c)); keys serialized as 'row:index'."""
+        got = self._rt.cluster.dump_worker_stacks(row=row,
+                                                  timeout=timeout)
+        return {f"{r}:{i}": text for (r, i), text in got.items()}
 
     def _connect(self, job_runtime_env: dict | None) -> dict:
         """A client attaches: allocate it a job id; a job-level env from

@@ -164,6 +164,10 @@ class NodeAgent:
         self._pin_lock = threading.Lock()
         # -- autonomous local dispatch state --------------------------------
         self._fast_enabled = False      # head policy (register reply)
+        self._policy_pushed = False     # an a_policy push wins over a
+        #                                 concurrently-computed register
+        #                                 reply (job env landing mid-
+        #                                 registration)
         self._view_lock = threading.Lock()
         self._totals_cu: dict[str, int] = {}
         self._avail_cu: dict[str, int] = {}
@@ -252,11 +256,15 @@ class NodeAgent:
         with self._view_lock:
             self._totals_cu = dict(cu)
             self._avail_cu = dict(cu)
-        self._fast_enabled = fast
+        if not self._policy_pushed:
+            # a push that raced in DURING registration is newer than
+            # the reply's snapshot — don't overwrite it
+            self._fast_enabled = fast
 
     def _a_policy(self, policy: dict) -> bool:
         """Head policy push (e.g. a job-level runtime_env appearing
         gates the env-blind fast path off)."""
+        self._policy_pushed = True
         self._fast_enabled = bool(policy.get("fast_path", False))
         return True
 
@@ -328,6 +336,7 @@ class NodeAgent:
         their done-sync — drop them (the head's drain fails/retries
         registered ones, exactly like node death)."""
         self._fast_enabled = False
+        self._policy_pushed = False     # fresh head: fresh policy
         with self._sync_lock:
             self._sync_batch.clear()
         entries = list(self._local_tasks.values())
@@ -885,20 +894,26 @@ class NodeAgent:
             self._finish_local(entry, None, None, msg[2], "error")
             self._drain_local_queue()
             return
-        tid = TaskID(tid_bin)
-        descs = []
-        for i, data in enumerate(msg[2]):
-            if len(data) > self.store._threshold:
-                oid = ObjectID.for_task_return(tid, i + 1)
-                self.store.put_serialized(oid, data)
-                k, size = self.store.plasma_info(oid)
-                if k in ("shm", "spill"):
-                    descs.append(("p", oid.binary(), size))
-                    continue
-            descs.append(("v", data))
-        self._finish_local(entry, descs,
-                           msg[3] if len(msg) > 3 else None, None,
-                           "done")
+        try:
+            tid = TaskID(tid_bin)
+            descs = []
+            for i, data in enumerate(msg[2]):
+                if len(data) > self.store._threshold:
+                    oid = ObjectID.for_task_return(tid, i + 1)
+                    self.store.put_serialized(oid, data)
+                    k, size = self.store.plasma_info(oid)
+                    if k in ("shm", "spill"):
+                        descs.append(("p", oid.binary(), size))
+                        continue
+                descs.append(("v", data))
+            self._finish_local(entry, descs,
+                               msg[3] if len(msg) > 3 else None, None,
+                               "done")
+        except Exception:   # noqa: BLE001 — seal failure (arena+spill
+            # exhausted, ...): the entry is already popped, so the
+            # handback must happen HERE or the head record never
+            # completes and the caller hangs
+            self._finish_local(entry, None, None, None, "retry")
         self._drain_local_queue()
 
     def _finish_local(self, entry, descs, contained, err_bytes,
@@ -972,13 +987,15 @@ class NodeAgent:
         N local leases costs O(1) head frames, not O(N)."""
         import time
         while not self._stopping and not self._stop_event.is_set():
-            if not self._sync_wake.wait(timeout=0.5):
-                continue
-            time.sleep(0.002)           # coalesce a burst
-            self._sync_wake.clear()
+            if self._sync_wake.wait(timeout=0.5):
+                time.sleep(0.002)       # coalesce a burst
+                self._sync_wake.clear()
             # stale local leases (queued past the lease timeout behind
             # blocked/busy workers) spill back to the head for global
-            # placement — the raylet's stale-lease spillback, agent-side
+            # placement — the raylet's stale-lease spillback, agent-
+            # side.  Runs on EVERY tick including wake timeouts: a
+            # stranded queue with no further sync traffic is exactly
+            # the case that must still spill
             from ..common.config import get_config
             stale_after = get_config().worker_lease_timeout_ms / 1000.0
             now = time.monotonic()

@@ -1704,6 +1704,10 @@ class Raylet:
             self.cluster.stream_ack(TaskID(msg[1]), msg[2])
         elif kind == "stream_close_up":
             self.cluster.stream_close(TaskID(msg[1]), msg[2])
+        elif kind == "stacks_reply":
+            # live stack sample answered by the worker's reader thread
+            self.cluster._on_stacks_reply(msg[1], self.row,
+                                          worker.index, msg[2])
         elif kind == "refs":
             # this worker's batched local incref/decref events fold
             # against its holder entry (distributed refcounting)

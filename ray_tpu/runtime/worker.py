@@ -46,6 +46,20 @@ _REPLY_KINDS = frozenset({"get_reply", "get_reply_x", "wait_reply",
                           "stream_wait_reply"})
 
 
+def _format_all_stacks() -> str:
+    """Every thread's current Python stack, named — what is this
+    process doing RIGHT NOW."""
+    import sys
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = [f"pid {os.getpid()}"]
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, ident)} ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
 class ArgRef:
     """A task argument shipped as a store descriptor instead of a value:
     shm-resident args are read zero-copy from the worker's arena mapping
@@ -139,6 +153,16 @@ class WorkerApiContext:
                 break
             if msg[0] in _REPLY_KINDS:
                 self._reply_q.put(msg)
+            elif msg[0] == "dump_stacks":
+                # live stack sampling (upstream: the dashboard's py-spy
+                # integration — SURVEY §5.1(c)): answered ON THE READER
+                # THREAD so a worker wedged in user code (the exact
+                # case you want to inspect) still replies
+                try:
+                    self.send(("stacks_reply", msg[1],
+                               _format_all_stacks()))
+                except Exception:   # noqa: BLE001 — diagnostics only
+                    pass
             elif msg[0] == "stream_ack":
                 # out-of-band: the main thread is inside the generator.
                 # Only ACTIVE streams record (a late ack after

@@ -244,6 +244,24 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_stacks(args) -> int:
+    """Live all-thread stacks of every worker (the reference
+    dashboard's py-spy stack sampling — SURVEY.md §5.1(c))."""
+    client = _client(args.address)
+    try:
+        stacks = client.call("worker_stacks", args.node_row, 5.0,
+                             timeout=40.0)
+    finally:
+        client.close()
+    if not stacks:
+        print("no workers replied")
+        return 1
+    for key in sorted(stacks):
+        print(f"===== worker {key} =====")
+        print(stacks[key])
+    return 0
+
+
 def cmd_timeline(args) -> int:
     client = _client(args.address)
     try:
@@ -417,6 +435,12 @@ def build_parser() -> argparse.ArgumentParser:
     pt.add_argument("--address", default=None)
     pt.add_argument("-o", "--output", default=None)
     pt.set_defaults(fn=cmd_timeline)
+
+    ps2 = sub.add_parser("stacks",
+                         help="live worker stack dump (py-spy analogue)")
+    ps2.add_argument("--address", default=None)
+    ps2.add_argument("--node-row", type=int, default=None)
+    ps2.set_defaults(fn=cmd_stacks)
 
     pj = sub.add_parser("job", help="job submission")
     pj.add_argument("--address", default=None)

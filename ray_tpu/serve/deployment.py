@@ -491,7 +491,19 @@ def run(app: Application, *, name: str = "default",
         return h
 
     dep = app.deployment
-    handle = build(app)
+    try:
+        handle = build(app)
+    except BaseException:
+        # a mid-build failure (cycle, replica init hang/raise) must not
+        # leak the child controllers already materialized — nothing
+        # else would ever reference them
+        for ctl in reversed(controllers):
+            try:
+                ray_tpu.get(ctl.shutdown.remote(), timeout=30)
+                ray_tpu.kill(ctl)
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                pass
+        raise
     controller = controllers.pop()      # the root's (built last)
     if route_prefix is not None:
         # a generator __call__ makes the HTTP route STREAMING: chunked

@@ -183,6 +183,11 @@ class ClientRuntime:
         return self._call("kv", op, key, value, namespace, overwrite)
 
     # -- introspection (api module functions duck-type onto these) -----------
+    def worker_stacks(self, node_row: int | None = None,
+                      timeout: float = 5.0) -> dict:
+        return self._call("worker_stacks", node_row, timeout,
+                          timeout=timeout + 30.0)
+
     def nodes(self) -> list[dict]:
         return self._call("nodes")
 

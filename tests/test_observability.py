@@ -150,3 +150,63 @@ class TestEventLogAndTimeline:
             assert os.path.exists(tmp_path / "mylogs" / "events.jsonl")
         finally:
             c.stop()
+
+
+class TestWorkerStacks:
+    """Live per-worker stack sampling (the reference dashboard's
+    py-spy integration — SURVEY.md §5.1(c)): answered on the worker's
+    reader thread, so a worker WEDGED in user code still reports."""
+
+    def test_stuck_worker_shows_user_frame(self, driver):
+        import time as _time
+
+        @ray_tpu.remote
+        def stuck_in_user_code():
+            _time.sleep(8)      # the "wedge" the dump must reveal
+            return "done"
+
+        ref = stuck_in_user_code.remote()
+        _time.sleep(1.0)        # let it reach the sleep
+        stacks = ray_tpu.worker_stacks(timeout=5.0)
+        assert stacks, "no workers replied"
+        joined = "\n".join(stacks.values())
+        assert "stuck_in_user_code" in joined, joined[-2000:]
+        assert "rt-worker-reader" in joined      # all threads shown
+        assert ray_tpu.get(ref, timeout=60) == "done"
+
+    def test_idle_workers_still_reply(self, driver):
+        stacks = ray_tpu.worker_stacks(timeout=5.0)
+        assert len(stacks) >= 1
+        for key, text in stacks.items():
+            assert ":" in key and "pid " in text
+
+    def test_agent_workers_report_too(self):
+        import time as _time
+
+        from ray_tpu.runtime.head import HeadNode
+        from ray_tpu.runtime.node_agent import NodeAgent
+        head = HeadNode(resources={"CPU": 2, "memory": 2},
+                        num_workers=1)
+        agent = NodeAgent(head.address,
+                          resources={"CPU": 2, "memory": 2,
+                                     "rslot": 1},
+                          num_workers=1)
+        deadline = _time.monotonic() + 60
+        while len(ray_tpu.nodes()) != 2:
+            assert _time.monotonic() < deadline
+            _time.sleep(0.1)
+        try:
+            @ray_tpu.remote(resources={"CPU": 1, "rslot": 1})
+            def remote_stuck():
+                _time.sleep(6)
+                return "ok"
+
+            ref = remote_stuck.remote()
+            _time.sleep(1.5)
+            stacks = ray_tpu.worker_stacks(timeout=8.0)
+            joined = "\n".join(stacks.values())
+            assert "remote_stuck" in joined, sorted(stacks)
+            assert ray_tpu.get(ref, timeout=60) == "ok"
+        finally:
+            agent.stop()
+            head.stop()
