@@ -504,7 +504,8 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None):
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     rt = _get_runtime()
     if rt.is_driver:
-        rt.raylet.cancel(ref.task_id(), force=force)
+        # the task may be queued/running/agent-leased on ANY node
+        rt.cluster.cancel_task(ref.task_id(), force=force)
     elif hasattr(rt, "cancel_task"):    # client mode
         rt.cancel_task(ref.task_id(), force=force)
 

@@ -427,6 +427,20 @@ class Cluster:
                 # release with them (contained-entry bookkeeping)
                 self.ref_counter.force_reclaim(oid)
 
+    def cancel_task(self, task_id, force: bool = False) -> bool:
+        """Cancel wherever the task lives — any node's queues, running
+        set, or agent lease (drivers and the client-mode head RPC both
+        route here)."""
+        head = self.head()
+        if head.cancel(task_id, force=force):
+            return True
+        with self._lock:
+            raylets = list(self.raylets.values())
+        for r in raylets:
+            if r is not head and r.cancel(task_id, force=force):
+                return True
+        return False
+
     # -- routing (spillback) ------------------------------------------------
     def route_local(self, row: int, task_id) -> bool:
         """Deliver a PLACED task into the target node's local dispatch

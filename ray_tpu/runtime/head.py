@@ -306,7 +306,9 @@ class HeadNode:
         return aid.binary() if aid is not None else None
 
     def _cancel(self, task_bin: bytes, force: bool) -> None:
-        self._rt.raylet.cancel(TaskID(task_bin), force=force)
+        # cluster-wide: the task may be queued/running/agent-leased on
+        # any node, not just the head's raylet
+        self._rt.cluster.cancel_task(TaskID(task_bin), force=force)
 
     def _kv(self, op: str, key: bytes, value: bytes | None,
             namespace: str, overwrite: bool):

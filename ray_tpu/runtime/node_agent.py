@@ -112,12 +112,16 @@ class NodeAgent:
     locally-resident plasma objects are served from the agent arena
     the same way (no head round-trip).
 
-    Known v1 limits, by design: head-side ``ray.cancel`` cannot reach
-    an agent-leased task (no frame addresses it); a local worker death
-    hands the task BACK to the head with a ``retry`` disposition
-    rather than retrying in place; transient resource oversubscription
-    between the head's CRM and the local view is bounded by the worker
-    pool (the same class of slack as ``force_subtract``)."""
+    ``ray.cancel`` reaches agent-leased tasks: the head seals the
+    cancellation and completes its record first (so any in-flight
+    done/retry sync is skipped), then asks the agent over ``a_cancel``
+    to drop the queued entry or force-kill the running worker.
+
+    Known v1 limits, by design: a local worker death hands the task
+    BACK to the head with a ``retry`` disposition rather than retrying
+    in place; transient resource oversubscription between the head's
+    CRM and the local view is bounded by the worker pool (the same
+    class of slack as ``force_subtract``)."""
 
     def __init__(self, head_address: str,
                  resources: dict[str, float] | None = None,
@@ -200,6 +204,7 @@ class NodeAgent:
             "a_stop": self._a_stop,
             "a_ping": lambda: "ok",
             "a_policy": self._a_policy,
+            "a_cancel": self._a_cancel,
         }
         handlers.update(self.plane.handlers())
         self.server = RpcServer(handlers, host=host, port=port).start()
@@ -766,6 +771,31 @@ class NodeAgent:
             pass
         return True
 
+    def _a_cancel(self, tid_bin: bytes, force: bool) -> str:
+        """Head-initiated cancel of an agent-leased task.  The head
+        already sealed the cancellation error and completed the record
+        — here we only stop the wasted work: drop a queued entry, or
+        (force) kill the worker running it (its death handback finds
+        the record done at the head and is skipped)."""
+        import time as _time
+        with self._view_lock:
+            for e in list(self._local_queue):
+                if e["spec"].task_id.binary() == tid_bin:
+                    self._local_queue.remove(e)
+                    return "dequeued"
+        entry = self._local_tasks.get(tid_bin)
+        if entry is None:
+            # dispatch window: the drain popped the queue entry but
+            # has not inserted the running record yet — re-check once
+            _time.sleep(0.1)
+            entry = self._local_tasks.get(tid_bin)
+            if entry is None:
+                return "unknown"
+        if force:
+            self._a_kill(entry["index"])
+            return "killed"
+        return "running"
+
     def _fetch_fn_async(self, fn_id: str) -> None:
         with self._lock:
             if fn_id in self._fn_fetching or fn_id in self._fn_cache:
@@ -1174,6 +1204,18 @@ class AgentSpawner:
         except Exception:       # noqa: BLE001 — best-effort, like SIGKILL
             pass                # on an already-dead pid
 
+    def cancel_remote(self, tid_bin: bytes, force: bool) -> str | None:
+        """Cancel an agent-leased task: drop it from the agent's local
+        queue, or (force) kill the worker running it.  Returns the
+        agent's verdict ("dequeued"/"killed"/"running"/"unknown") or
+        None when the agent is unreachable — the caller decides what
+        to seal from it."""
+        try:
+            return self._client.call("a_cancel", tid_bin, force,
+                                     timeout=10.0)
+        except Exception:       # noqa: BLE001
+            return None
+
     def set_policy(self, policy: dict) -> None:
         """Push an autonomy-policy update (job-env gating) to the
         agent; best-effort — a dropped push only disables/keeps the
@@ -1384,6 +1426,14 @@ class AgentHub:
         if rec is None:
             rec = tm.get(tid)
         if rec is None or rec.done:
+            # a record completed elsewhere (cancel raced completion):
+            # the agent-arena copies described here have no owner and
+            # would leak until agent restart — free them
+            from ..common.ids import ObjectID as _OID
+            for d in (descs or ()):
+                if d[0] == "p" and raylet.plane_address is not None:
+                    cluster.plane.free_on(raylet.plane_address,
+                                          [_OID(d[1])])
             return
         if disposition == "requeue":
             # never ran on the agent (stale lease, worker vanished

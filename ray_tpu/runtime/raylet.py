@@ -2056,6 +2056,26 @@ class Raylet:
         if entry is not None and force:
             self.pool.kill_worker(entry[1])  # death path does bookkeeping
             return True
+        # agent-leased task (autonomous dispatch): ask the agent what
+        # state it is in, then mirror the head-local semantics —
+        # a QUEUED task cancels outright; a RUNNING one cancels only
+        # under force (the kill); non-force running returns False like
+        # the local path.  Sealing here may race a just-completed
+        # done-sync: _cancel_seal_and_complete no-ops on a done record,
+        # and AgentHub._sync_done frees agent-arena descs of a record
+        # completed elsewhere, so neither side leaks.
+        rec_a = self.agent_inflight.get(task_id)
+        if rec_a is not None:
+            sp = getattr(self.pool, "_spawner", None)
+            verdict = None
+            if sp is not None and hasattr(sp, "cancel_remote"):
+                verdict = sp.cancel_remote(task_id.binary(), force)
+            if verdict == "dequeued" or (force and
+                                         verdict == "killed"):
+                self.agent_inflight.pop(task_id, None)
+                self._cancel_seal_and_complete(task_id)
+                return True
+            return False
         return False
 
     def drain_for_removal(self, fallback: "Raylet") -> None:

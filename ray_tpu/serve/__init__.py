@@ -12,9 +12,11 @@ proxy routes ``route_prefix`` requests into the replica sets — SURVEY.md
 
 from .deployment import (Application, Deployment, DeploymentHandle,
                          delete, deployment, get_deployment_handle,
-                         http_address, run, shutdown, start, status)
+                         get_multiplexed_model_id, http_address,
+                         multiplexed, run, shutdown, start, status)
 from .http_proxy import HTTPRequest
 
 __all__ = ["Application", "Deployment", "DeploymentHandle", "delete",
-           "deployment", "get_deployment_handle", "http_address",
-           "HTTPRequest", "run", "shutdown", "start", "status"]
+           "deployment", "get_deployment_handle",
+           "get_multiplexed_model_id", "http_address", "HTTPRequest",
+           "multiplexed", "run", "shutdown", "start", "status"]

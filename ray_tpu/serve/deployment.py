@@ -23,6 +23,97 @@ def _api():
     return ray_tpu
 
 
+# -- model multiplexing ------------------------------------------------------
+
+_MUX_LOCK = threading.Lock()    # per-process: guards replica LRU caches
+_mux_model_id: "Any" = None     # ContextVar, created lazily
+
+
+def _mux_var():
+    global _mux_model_id
+    if _mux_model_id is None:
+        import contextvars
+        _mux_model_id = contextvars.ContextVar("serve_mux_model",
+                                               default="")
+    return _mux_model_id
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica method: the model id the current request was
+    routed with (reference: ``serve.get_multiplexed_model_id``)."""
+    return _mux_var().get()
+
+
+def multiplexed(fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate a replica's model-loader method: results cache per
+    replica in an LRU bounded at ``max_num_models_per_replica``
+    (reference: ``@serve.multiplexed`` model multiplexing — one
+    replica set serves MANY models, each loaded on demand and evicted
+    least-recently-used).  Pair with
+    ``handle.options(multiplexed_model_id=...)``, which routes every
+    call for one model id to the same replica (rendezvous hashing) so
+    its cache stays hot."""
+    import functools
+    cap = max(int(max_num_models_per_replica), 1)
+
+    def deco(loader):
+        # the cache lives ON the instance and the lock is a module
+        # global: the deployment target class must stay picklable, so
+        # the closure may capture only plain values
+        cache_attr = f"_serve_mux_cache_{loader.__name__}"
+
+        pending_attr = f"_serve_mux_pending_{loader.__name__}"
+
+        @functools.wraps(loader)
+        def wrapper(self, model_id: str):
+            import threading as _threading
+            from collections import OrderedDict
+
+            # late import: a module-global referenced directly would be
+            # captured BY VALUE when cloudpickle ships the enclosing
+            # user class, and locks don't pickle
+            from ray_tpu.serve.deployment import _MUX_LOCK
+            while True:
+                with _MUX_LOCK:
+                    cache = getattr(self, cache_attr, None)
+                    if cache is None:
+                        cache = OrderedDict()
+                        setattr(self, cache_attr, cache)
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    pending = getattr(self, pending_attr, None)
+                    if pending is None:
+                        pending = {}
+                        setattr(self, pending_attr, pending)
+                    ev = pending.get(model_id)
+                    if ev is None:
+                        # we lead the load; concurrent cold requests
+                        # for the same model WAIT instead of each
+                        # running the expensive loader
+                        pending[model_id] = _threading.Event()
+                        break
+                ev.wait(timeout=600.0)
+                # leader finished (or failed): re-check the cache; a
+                # failed leader leaves it absent and a follower leads
+            try:
+                model = loader(self, model_id)  # load OUTSIDE the lock
+                with _MUX_LOCK:
+                    cache[model_id] = model
+                    cache.move_to_end(model_id)
+                    while len(cache) > cap:
+                        cache.popitem(last=False)   # evict LRU
+                return model
+            finally:
+                with _MUX_LOCK:
+                    ev2 = pending.pop(model_id, None)
+                if ev2 is not None:
+                    ev2.set()
+        wrapper._serve_multiplexed = True
+        return wrapper
+    return deco if fn is None else deco(fn)
+
+
 # -- replica shell -----------------------------------------------------------
 
 class _ReplicaShell:
@@ -45,26 +136,45 @@ class _ReplicaShell:
         self._obj = target(*args, **kwargs)
         self._kv_key = kv_key.encode()
 
-    def __serve_call__(self, method: str, args: tuple, kwargs: dict):
+    def __serve_call__(self, method: str, args: tuple, kwargs: dict,
+                       model_id: str = ""):
         import inspect
 
         from ray_tpu.experimental.internal_kv import _internal_kv_incr
 
         def settle():
             _internal_kv_incr(self._kv_key, -1, namespace="serve")
+        token = _mux_var().set(model_id) if model_id else None
         try:
             out = getattr(self._obj, method)(*args, **kwargs)
         except BaseException:
             settle()
             raise
+        finally:
+            if token is not None:
+                _mux_var().reset(token)
         if inspect.isgenerator(out):
             # a STREAMING response stays in the inflight count until
             # the stream finishes — calling the generator function
             # returns instantly, and settling then would leave the
-            # autoscaler blind to long-running streams
+            # autoscaler blind to long-running streams.  The model-id
+            # var re-wraps EVERY advance: the body only executes at
+            # next(), long after the outer finally reset the token,
+            # and a token left set across a yield would bleed into
+            # interleaved calls on the same thread
             def stream():
                 try:
-                    yield from out
+                    while True:
+                        tok = _mux_var().set(model_id) if model_id \
+                            else None
+                        try:
+                            item = next(out)
+                        except StopIteration:
+                            return
+                        finally:
+                            if tok is not None:
+                                _mux_var().reset(tok)
+                        yield item
                 finally:
                     settle()
             return stream()
@@ -191,10 +301,11 @@ class DeploymentHandle:
     """
 
     def __init__(self, controller_handle, method: str = "__call__",
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self._controller = controller_handle
         self._method = method
         self._stream = stream
+        self._mux_id = multiplexed_model_id
         self._lock = threading.Lock()
         self._version = -1
         self._replicas: list = []
@@ -205,14 +316,21 @@ class DeploymentHandle:
         self._outstanding: dict[bytes, int] = {}
 
     def options(self, *, method_name: str | None = None,
-                stream: bool | None = None) -> "DeploymentHandle":
+                stream: bool | None = None,
+                multiplexed_model_id: str | None = None
+                ) -> "DeploymentHandle":
         """``stream=True``: calls return an ObjectRefGenerator — the
         replica method must be a generator; items stream back with
-        backpressure (reference: handle.options(stream=True))."""
+        backpressure (reference: handle.options(stream=True)).
+        ``multiplexed_model_id``: route every call for this model to
+        the same replica (rendezvous hashing) so its ``@multiplexed``
+        LRU cache stays hot."""
         return DeploymentHandle(
             self._controller,
             method_name if method_name is not None else self._method,
-            stream if stream is not None else self._stream)
+            stream if stream is not None else self._stream,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._mux_id)
 
     def _refresh(self) -> None:
         version, replicas, kv_key = _api().get(
@@ -224,9 +342,20 @@ class DeploymentHandle:
 
     def _pick_replica(self):
         """Power of two choices on the local outstanding view; ties and
-        the single-replica case fall back to round robin."""
+        the single-replica case fall back to round robin.  A
+        multiplexed model id overrides with rendezvous hashing: one
+        model's calls stick to one replica (until the replica set
+        changes), keeping its LRU model cache hot."""
         import random
         n = len(self._replicas)
+        if self._mux_id and n > 1:
+            import hashlib
+            self._rr += 1
+            return max(
+                self._replicas,
+                key=lambda rep: hashlib.md5(
+                    rep._actor_id.binary()
+                    + self._mux_id.encode()).digest())
         if n == 1:
             self._rr += 1
             return self._replicas[0]
@@ -285,7 +414,7 @@ class DeploymentHandle:
         if self._stream:
             gen = ActorMethod(replica, "__serve_call__",
                               num_returns="streaming").remote(
-                self._method, args, kwargs)
+                self._method, args, kwargs, self._mux_id)
             # streaming load settles optimistically (no single seal to
             # observe); the KV inflight decrements at generator return
             with self._lock:
@@ -294,13 +423,14 @@ class DeploymentHandle:
                     self._outstanding[rkey] = c - 1
             return gen
         ref = ActorMethod(replica, "__serve_call__").remote(
-            self._method, args, kwargs)
+            self._method, args, kwargs, self._mux_id)
         self._settle(rkey, ref)
         return ref
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._method, self._stream))
+                (self._controller, self._method, self._stream,
+                 self._mux_id))
 
 
 # -- deployment / application ------------------------------------------------

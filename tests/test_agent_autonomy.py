@@ -173,3 +173,37 @@ class TestAgentLocalDispatch:
             assert a._fast_enabled
         finally:
             a.stop()
+
+
+class TestAgentCancel:
+    def test_cancel_reaches_agent_leased_task(self, head, agent):
+        """ray.cancel on an agent-leased task's return: the head seals
+        the cancellation (callers unblock with TaskCancelledError) and
+        the agent drops/kills the local work."""
+        @ray_tpu.remote
+        def submit_slow_child():
+            @ray_tpu.remote
+            def slow():
+                time.sleep(30)
+                return "never"
+
+            r = slow.remote()
+            return r.id.binary(), r.task_id().binary()
+
+        parent = submit_slow_child.options(
+            resources={"CPU": 1, "remote_slot": 1})
+        oid_bin, tid_bin = ray_tpu.get(parent.remote(), timeout=120)
+        from ray_tpu.common.ids import ObjectID, TaskID
+        from ray_tpu.runtime.object_ref import ObjectRef
+        # wait until the head learns of the lease (started sync)
+        rt = ray_tpu.api._get_runtime()
+        tid = TaskID(tid_bin)
+        deadline = time.monotonic() + 15
+        while rt.cluster.task_manager.get(tid) is None:
+            assert time.monotonic() < deadline, "started sync missing"
+            time.sleep(0.05)
+        ref = ObjectRef(ObjectID(oid_bin))
+        ray_tpu.cancel(ref, force=True)
+        from ray_tpu.runtime.serialization import TaskCancelledError
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)

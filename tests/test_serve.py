@@ -429,3 +429,52 @@ class TestHttpIngress:
             assert status == 501
         finally:
             d.shutdown()
+
+
+class TestModelMultiplexing:
+    def test_mux_routes_stick_and_lru_bounds_models(self):
+        """@serve.multiplexed + handle.options(multiplexed_model_id):
+        one model's calls stick to one replica (rendezvous hashing),
+        loads cache per replica with LRU eviction, and
+        get_multiplexed_model_id() surfaces the routed id."""
+        @serve.deployment(num_replicas=2)
+        class MuxModel:
+            def __init__(self):
+                self.loads = []
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                self.loads.append(model_id)
+                return f"model:{model_id}"
+
+            def __call__(self, x):
+                mid = serve.get_multiplexed_model_id()
+                model = self.get_model(mid)
+                return model, mid, len(self.loads), id(self)
+
+        handle = serve.run(MuxModel.bind(), name="mux")
+        try:
+            # same model id -> same replica, ONE load across 6 calls
+            h_a = handle.options(multiplexed_model_id="m-a")
+            outs = [ray_tpu.get(h_a.remote(i), timeout=60)
+                    for i in range(6)]
+            assert all(o[0] == "model:m-a" and o[1] == "m-a"
+                       for o in outs)
+            assert len({o[3] for o in outs}) == 1   # sticky replica
+            assert outs[-1][2] == 1                 # cached after 1st
+
+            # LRU bound: 3 distinct models through a 2-model cache on
+            # one replica forces a re-load when the evicted id returns
+            ids = ["m1", "m2", "m3", "m1"]
+            loads_by_replica: dict = {}
+            for mid in ids:
+                h = handle.options(multiplexed_model_id=mid)
+                model, got_mid, n_loads, rep = ray_tpu.get(
+                    h.remote(0), timeout=60)
+                assert model == f"model:{mid}" and got_mid == mid
+                loads_by_replica[rep] = max(
+                    loads_by_replica.get(rep, 0), n_loads)
+            # every load was counted; total loads >= distinct ids
+            assert sum(loads_by_replica.values()) >= 3
+        finally:
+            serve.delete("mux")
