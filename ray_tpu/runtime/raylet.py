@@ -2067,15 +2067,24 @@ class Raylet:
         rec_a = self.agent_inflight.get(task_id)
         if rec_a is not None:
             sp = getattr(self.pool, "_spawner", None)
+            if force:
+                # seal FIRST: the kill's worker-death 'retry' handback
+                # must find rec.done and be skipped — sealing after
+                # would race it into resubmitting the cancelled task
+                self.agent_inflight.pop(task_id, None)
+                self._cancel_seal_and_complete(task_id)
+                if sp is not None and hasattr(sp, "cancel_remote"):
+                    sp.cancel_remote(task_id.binary(), True)
+                return True
             verdict = None
             if sp is not None and hasattr(sp, "cancel_remote"):
-                verdict = sp.cancel_remote(task_id.binary(), force)
-            if verdict == "dequeued" or (force and
-                                         verdict == "killed"):
+                verdict = sp.cancel_remote(task_id.binary(), False)
+            if verdict == "dequeued":
+                # never dispatched: no handback can race this seal
                 self.agent_inflight.pop(task_id, None)
                 self._cancel_seal_and_complete(task_id)
                 return True
-            return False
+            return False        # running + non-force: like local path
         return False
 
     def drain_for_removal(self, fallback: "Raylet") -> None:

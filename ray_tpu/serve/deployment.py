@@ -26,15 +26,14 @@ def _api():
 # -- model multiplexing ------------------------------------------------------
 
 _MUX_LOCK = threading.Lock()    # per-process: guards replica LRU caches
-_mux_model_id: "Any" = None     # ContextVar, created lazily
+# created eagerly at import: a lazily-raced creation could hand two
+# threads DIFFERENT vars and silently lose a request's model id
+import contextvars as _contextvars  # noqa: E402
+
+_mux_model_id = _contextvars.ContextVar("serve_mux_model", default="")
 
 
 def _mux_var():
-    global _mux_model_id
-    if _mux_model_id is None:
-        import contextvars
-        _mux_model_id = contextvars.ContextVar("serve_mux_model",
-                                               default="")
     return _mux_model_id
 
 

@@ -9,6 +9,7 @@ lazily.
 
 from __future__ import annotations
 
+from .actor_pool import ActorPool
 from .placement_group import (PlacementGroup, placement_group,
                               placement_group_table,
                               remove_placement_group)
@@ -16,6 +17,8 @@ from .scheduling_strategies import (NodeAffinitySchedulingStrategy,
                                     NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy)
 
-__all__ = ["PlacementGroup", "placement_group", "placement_group_table",
-           "remove_placement_group", "PlacementGroupSchedulingStrategy",
-           "NodeAffinitySchedulingStrategy", "NodeLabelSchedulingStrategy"]
+__all__ = ["ActorPool", "PlacementGroup", "placement_group",
+           "placement_group_table", "remove_placement_group",
+           "PlacementGroupSchedulingStrategy",
+           "NodeAffinitySchedulingStrategy",
+           "NodeLabelSchedulingStrategy"]

@@ -326,3 +326,82 @@ class TestHeadRestore:
             assert ray_tpu.get(k2.get.remote(), timeout=60) == "fresh"
         finally:
             ray_tpu.shutdown()
+
+
+class TestAutonomyChaos:
+    """Agent death while AUTONOMOUS dispatch is mid-flight: callers
+    must fail or retry — never hang on tasks only the dead agent knew
+    about (agent-leased records drain exactly like node death)."""
+
+    def test_agent_sigkill_mid_local_fanout(self):
+        from ray_tpu.runtime.head import HeadNode
+        from ray_tpu.runtime.node_agent import NodeAgent
+
+        head = HeadNode(resources={"CPU": 2, "memory": 2},
+                        num_workers=1)
+        agent = None
+        try:
+            # in-process agent: its workers are real subprocesses, and
+            # stopping the RPC server + link simulates machine loss
+            agent = NodeAgent(head.address,
+                              resources={"CPU": 4, "memory": 4,
+                                         "aslot": 2},
+                              num_workers=2)
+            deadline = time.monotonic() + 60
+            while len(ray_tpu.nodes()) != 2:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+
+            @ray_tpu.remote(resources={"CPU": 1, "aslot": 1},
+                            max_retries=0)
+            def fanout_slow(n):
+                @ray_tpu.remote
+                def slow(i):
+                    time.sleep(20)
+                    return i
+
+                refs = [slow.remote(i) for i in range(n)]
+                return sum(ray_tpu.get(refs, timeout=120))
+
+            ref = fanout_slow.remote(6)
+            # let the agent accept + lease children locally, then die
+            rt = ray_tpu.api._get_runtime()
+            deadline = time.monotonic() + 30
+            got_leases = False
+            while time.monotonic() < deadline:
+                for r in rt.cluster.raylets.values():
+                    if r.agent_inflight:
+                        got_leases = True
+                        break
+                if got_leases:
+                    break
+                time.sleep(0.1)
+            assert got_leases, "no autonomous leases observed"
+            # abrupt loss: kill the worker procs + drop the link
+            for _i, (proc, _c) in list(agent._workers.items()):
+                try:
+                    os.kill(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            agent.server.stop()
+            agent._head.close()
+            # the caller UNBLOCKS: parent dies with the node
+            # (max_retries=0 -> WorkerCrashedError surface), and no
+            # agent-leased child leaves a dangling inflight record
+            with pytest.raises(Exception):
+                ray_tpu.get(ref, timeout=60)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if all(not r.agent_inflight
+                       for r in rt.cluster.raylets.values()):
+                    break
+                time.sleep(0.2)
+            assert all(not r.agent_inflight
+                       for r in rt.cluster.raylets.values())
+        finally:
+            if agent is not None:
+                try:
+                    agent.stop()
+                except Exception:
+                    pass
+            head.stop()
