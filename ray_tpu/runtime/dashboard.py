@@ -166,6 +166,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/tasks">tasks</a> · '
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
+            '<a href="/api/stacks">stacks</a> · '
             '<a href="/api/timeline">timeline</a> · '
             '<a href="/api/jobs">jobs</a> · '
             '<a href="/metrics">metrics</a></p>')

@@ -188,6 +188,16 @@ class NodeAgent:
         # older than the lease timeout hand back to the head
         self._local_queue: deque = deque()
         self._LOCAL_QUEUE_CAP = 1024
+        # small local-task results CACHE (authority stays at the head,
+        # which got the bytes in the done-sync): serves local gets of
+        # tiny results without a head round-trip.  LRU-bounded; a miss
+        # just relays, and a stale entry can only duplicate bytes the
+        # id still names (ids are never reused for different values)
+        self._small_cache: "dict[bytes, bytes]" = {}
+        self._small_cache_order: deque = deque()
+        self._small_cache_bytes = 0
+        self._small_cache_lock = threading.Lock()   # pump threads race
+        self._SMALL_CACHE_CAP = 32 << 20
         self._sync_lock = threading.Lock()
         # ONE ordered batch of ("refs"|"started"|"done", ...) entries:
         # a single stream preserves every intra-agent ordering the
@@ -352,6 +362,10 @@ class NodeAgent:
             self.store.unpin(e["pins"])
         self._head_tasks.clear()
         self._fn_uploaded.clear()       # the new head has a fresh registry
+        with self._small_cache_lock:
+            self._small_cache.clear()
+            self._small_cache_order.clear()
+            self._small_cache_bytes = 0
         with self._view_lock:
             self._avail_cu = dict(self._totals_cu)
         self._w_state.clear()
@@ -928,13 +942,14 @@ class NodeAgent:
             tid = TaskID(tid_bin)
             descs = []
             for i, data in enumerate(msg[2]):
+                oid = ObjectID.for_task_return(tid, i + 1)
                 if len(data) > self.store._threshold:
-                    oid = ObjectID.for_task_return(tid, i + 1)
                     self.store.put_serialized(oid, data)
                     k, size = self.store.plasma_info(oid)
                     if k in ("shm", "spill"):
                         descs.append(("p", oid.binary(), size))
                         continue
+                self._small_cache_put(oid.binary(), data)
                 descs.append(("v", data))
             self._finish_local(entry, descs,
                                msg[3] if len(msg) > 3 else None, None,
@@ -973,11 +988,27 @@ class NodeAgent:
                         if i == index]:
             self._credit_head_task(tid_bin)
 
+    def _small_cache_put(self, oid_bin: bytes, data: bytes) -> None:
+        if len(data) > self.store._threshold:
+            return      # not small: the arena/spill already holds it
+        with self._small_cache_lock:
+            if oid_bin in self._small_cache:
+                return
+            self._small_cache[oid_bin] = data
+            self._small_cache_order.append(oid_bin)
+            self._small_cache_bytes += len(data)
+            while self._small_cache_bytes > self._SMALL_CACHE_CAP and \
+                    self._small_cache_order:
+                old = self._small_cache_order.popleft()
+                dropped = self._small_cache.pop(old, None)
+                if dropped is not None:
+                    self._small_cache_bytes -= len(dropped)
+
     def _try_local_get(self, index: int, msg) -> bool:
-        """Serve a worker's get entirely from the local arena when
-        every requested object is plasma-resident HERE (the data is
-        already on this machine — a head round-trip would only copy
-        the descriptor path, not the bytes)."""
+        """Serve a worker's get entirely from this machine when every
+        requested object is plasma-resident in the local arena OR a
+        cached small local-task result (the data is already here — a
+        head round-trip would only copy the descriptor path)."""
         from .object_store import PLASMA_KINDS
         oids = [ObjectID(b) for b in msg[1]]
         if not oids:
@@ -985,6 +1016,10 @@ class NodeAgent:
         descs, pins = [], []
         try:
             for o in oids:
+                small = self._small_cache.get(o.binary())
+                if small is not None:
+                    descs.append(("b", small))
+                    continue
                 kind, _ = self.store.plasma_info(o)
                 if kind not in PLASMA_KINDS:
                     self.store.unpin(pins)
@@ -998,15 +1033,19 @@ class NodeAgent:
         except KeyError:
             self.store.unpin(pins)
             return False
-        with self._pin_lock:
-            self._get_pins.setdefault(index, deque()).append(pins)
+        if pins:
+            # pin batches enter the FIFO only when the reply carries
+            # "s" descriptors — the worker acks exactly those replies
+            with self._pin_lock:
+                self._get_pins.setdefault(index, deque()).append(pins)
         if not self._send_to_worker(index,
                                     ("get_reply_x", "ok", descs)):
-            with self._pin_lock:
-                dq = self._get_pins.get(index)
-                if dq and dq[-1] is pins:
-                    dq.pop()
-            self.store.unpin(pins)
+            if pins:
+                with self._pin_lock:
+                    dq = self._get_pins.get(index)
+                    if dq and dq[-1] is pins:
+                        dq.pop()
+                self.store.unpin(pins)
             return False
         return True
 

@@ -47,10 +47,11 @@ class ActorPool:
 
     # -- collection ----------------------------------------------------------
     def get_next(self, timeout: float | None = None):
-        """Next result in SUBMISSION order.  The actor returns to the
-        pool BEFORE the blocking get: a task exception or timeout must
-        not leak the actor or desync the cursor (actors serialize their
-        calls, so an early re-submit simply queues behind)."""
+        """Next result in SUBMISSION order.  A timeout raises
+        TimeoutError WITHOUT consuming anything (retryable: wait
+        first, consume after).  The actor returns to the pool before
+        the final get, so a task exception never leaks it (actors
+        serialize their calls — an early re-submit just queues)."""
         if not self.has_next():
             raise StopIteration("no pending results")
         ref = self._index_to_future.get(self._next_return_index)
@@ -58,10 +59,13 @@ class ActorPool:
             raise RuntimeError(
                 "submissions are queued but the pool has no actors "
                 "to run them (all popped?)")
+        ready, _ = _api().wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
         del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         self._return_actor(ref)
-        return _api().get(ref, timeout=timeout)
+        return _api().get(ref)
 
     def get_next_unordered(self, timeout: float | None = None):
         """Next result in COMPLETION order."""
@@ -86,6 +90,9 @@ class ActorPool:
     def _return_actor(self, ref) -> None:
         _idx, actor = self._future_to_actor.pop(ref.binary())
         self._idle.append(actor)
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
         while self._pending_submits and self._idle:
             fn, value = self._pending_submits.pop(0)
             self.submit(fn, value)
@@ -107,9 +114,7 @@ class ActorPool:
     # -- pool membership -----------------------------------------------------
     def push(self, actor) -> None:
         self._idle.append(actor)
-        while self._pending_submits and self._idle:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+        self._drain_pending()
 
     def pop_idle(self):
         return self._idle.pop() if self._idle else None
