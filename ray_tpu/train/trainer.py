@@ -23,9 +23,18 @@ _ctx = threading.local()        # the worker-side TrainContext
 
 @dataclass
 class ScalingConfig:
+    """``min_workers`` makes the gang ELASTIC: a restart after failure
+    may shrink the world to whatever capacity remains (never below
+    ``min_workers``) instead of deadlocking on a full-size placement
+    that lost nodes can no longer satisfy, and later restarts grow
+    back toward ``num_workers`` as capacity returns (reference:
+    Train's elastic integration — SURVEY.md §2.4 elastic row; mount
+    empty)."""
+
     num_workers: int = 2
     resources_per_worker: dict[str, float] = field(
         default_factory=lambda: {"CPU": 1})
+    min_workers: int | None = None      # None = fixed-size gang
 
 
 @dataclass
@@ -220,31 +229,70 @@ class JaxTrainer:
         from ..runtime.serialization import deserialize, serialize
         from ..util.placement_group import (placement_group,
                                             remove_placement_group)
-        n = self._scaling.num_workers
+        n_target = self._scaling.num_workers
+        n_min = self._scaling.min_workers
         res = self._scaling.resources_per_worker
         # serialize BEFORE reserving anything: an unpicklable train
         # loop must fail without leaking a placement group
         fn_bytes = serialize(self._fn)
-        # gang placement: all workers or none (reference: Train
-        # reserves a PACK placement group before starting)
-        pg = placement_group([dict(res)] * n, strategy="PACK")
-        ray_tpu.get(pg.ready(), timeout=timeout)
-        shards: list = [None] * n
         train_ds = self._datasets.get("train")
-        if train_ds is not None:
-            shards = [s.take_all() for s in train_ds.split(n)]
         run_id = os.urandom(4).hex()
         persist_key = f"ckpt-{run_id}"
         max_failures = self._failure.max_failures
         attempt = 0
+        pg = None
+        pg_size = 0         # bundle count of the LIVE pg (pg is None ok)
+        shards: list = []
+        shard_world = -1    # world size the shards were cut for
+        log = logging.getLogger("ray_tpu.train")
         try:
             while True:
+                world = n_target
+                if attempt > 0 and n_min is not None \
+                        and n_min < n_target:
+                    # ELASTIC restart: drop OUR OWN reservation first
+                    # (it shadows exactly the capacity being measured),
+                    # then size to what single nodes can actually host
+                    # — never below min_workers; capacity that came
+                    # back grows the gang toward the target again
+                    if pg is not None:
+                        remove_placement_group(pg)
+                        pg = None
+                        import time as _time
+                        _time.sleep(0.5)    # let the release land
+                    world = max(min(n_target,
+                                    self._placeable_workers(res)),
+                                n_min)
+                    if world != pg_size:
+                        log.warning(
+                            "elastic gang resize: %d -> %d workers",
+                            pg_size, world)
                 raw = _internal_kv_get(persist_key, namespace="train")
                 ckpt_state = deserialize(raw) if raw is not None \
                     else None
                 try:
+                    if pg is None or world != pg_size:
+                        if pg is not None:
+                            remove_placement_group(pg)
+                            pg = None
+                        # gang placement: all-or-none (reference:
+                        # Train reserves a PACK group before starting).
+                        # pg_size updates BEFORE ready(): a timed-out
+                        # group still matches its recorded size, so a
+                        # later attempt never runs N workers against a
+                        # smaller group
+                        pg = placement_group([dict(res)] * world,
+                                             strategy="PACK")
+                        pg_size = world
+                        ray_tpu.get(pg.ready(), timeout=timeout)
+                    if shard_world != world:
+                        shards = [None] * world
+                        if train_ds is not None:
+                            shards = [s.take_all()
+                                      for s in train_ds.split(world)]
+                        shard_world = world
                     outs = self._run_gang(
-                        pg, fn_bytes, shards,
+                        pg, fn_bytes, shards, world,
                         f"train-{run_id}-a{attempt}", ckpt_state,
                         persist_key, timeout)
                     break
@@ -263,7 +311,8 @@ class JaxTrainer:
                 _internal_kv_del(persist_key, namespace="train")
             except Exception:   # noqa: BLE001 — a degraded KV must not
                 pass            # leak the PG or mask the gang error
-            remove_placement_group(pg)
+            if pg is not None:
+                remove_placement_group(pg)
         rank0_reports, ckpt_state = outs[0]
         return Result(
             metrics=rank0_reports[-1] if rank0_reports else {},
@@ -271,10 +320,39 @@ class JaxTrainer:
             if ckpt_state is not None else None,
             history=rank0_reports)
 
-    def _run_gang(self, pg, fn_bytes, shards, group,
+    @staticmethod
+    def _placeable_workers(res: dict) -> int:
+        """How many worker BUNDLES current availability fits.  Each
+        bundle must land whole on ONE node, so count per-node fits and
+        sum (an aggregate view would report fragmented capacity no
+        single node can host); client mode falls back to the aggregate
+        (its only view), which over-estimates at worst into a ready()
+        timeout that the retry loop absorbs."""
+        import ray_tpu
+        from ray_tpu.api import _get_runtime
+        rt = _get_runtime()
+        crm = getattr(rt, "crm", None)
+        if crm is not None:
+            from ray_tpu.common.resources import ResourceRequest
+            snap = crm.snapshot()
+            vec = ResourceRequest(res).dense(crm.resource_index,
+                                             snap.avail.shape[1])
+            total = 0
+            for row in range(snap.avail.shape[0]):
+                if not snap.node_mask[row]:
+                    continue
+                fits = [int(snap.avail[row, i]) // int(v)
+                        for i, v in enumerate(vec) if v > 0]
+                total += max(min(fits) if fits else 0, 0)
+            return total
+        avail = ray_tpu.available_resources()
+        counts = [int(avail.get(k, 0.0) // v)
+                  for k, v in res.items() if v > 0]
+        return max(min(counts) if counts else 0, 0)
+
+    def _run_gang(self, pg, fn_bytes, shards, n, group,
                   ckpt_state, persist_key, timeout) -> list:
         import ray_tpu
-        n = self._scaling.num_workers
         res = self._scaling.resources_per_worker
         worker_cls = ray_tpu.remote(_TrainWorker)
         actors: list = []

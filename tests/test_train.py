@@ -186,3 +186,57 @@ class TestFailureRecovery:
                 scaling_config=train.ScalingConfig(num_workers=2),
                 failure_config=train.FailureConfig(max_failures=1),
             ).fit(timeout=120)
+
+
+class TestElasticTraining:
+    def test_gang_shrinks_to_surviving_capacity(self):
+        """Elastic restart (ScalingConfig.min_workers): a 3-worker gang
+        crashes while a resource hog occupies most of the cluster; the
+        restart shrinks the world to what fits (>= min_workers) and
+        completes from the checkpoint with the SMALLER gang."""
+        import tempfile
+        import time as _time
+
+        from ray_tpu import train
+
+        # occupy capacity so only ~1 worker's CPU remains free during
+        # the restart window: the elastic resize must shrink, not
+        # deadlock waiting for a full 3-slot placement
+        @ray_tpu.remote(num_returns=1, resources={"CPU": 6})
+        def hog(dt):
+            _time.sleep(dt)
+            return "done"
+
+        def loop(config):
+            import os as _os
+            ctx = train.get_context()
+            ckpt = train.get_checkpoint()
+            start = ckpt.to_dict()["step"] if ckpt is not None else 0
+            marker = config["marker"]
+            for step in range(start, 4):
+                if step == 2 and ctx.get_world_rank() == 0 \
+                        and not _os.path.exists(marker):
+                    open(marker, "w").close()
+                    _os._exit(1)        # crash once at step 2
+                train.report(
+                    {"step": step, "world": ctx.get_world_size(),
+                     "resumed_from": start},
+                    checkpoint=train.Checkpoint({"step": step + 1}))
+
+        with tempfile.TemporaryDirectory() as td:
+            marker = os.path.join(td, "crashed")
+            hog_ref = hog.remote(25.0)
+            result = train.JaxTrainer(
+                loop,
+                train_loop_config={"marker": marker},
+                scaling_config=train.ScalingConfig(
+                    num_workers=3, min_workers=1),
+                failure_config=train.FailureConfig(max_failures=2),
+            ).fit(timeout=120)
+            assert os.path.exists(marker)
+            ray_tpu.get(hog_ref, timeout=60)
+        assert result.metrics["step"] == 3
+        assert result.metrics["resumed_from"] == 2   # from checkpoint
+        # the completing attempt ran SMALLER than the original gang
+        assert result.metrics["world"] < 3
+        assert result.metrics["world"] >= 1
