@@ -452,8 +452,15 @@ def init(resources: dict[str, float] | None = None,
         _runtime = DriverRuntime(JobID.next(), resources, num_workers,
                                  cluster=cluster)
         _runtime.namespace = namespace or ""
-        # workers inherit the job's namespace through the cluster
+        # workers inherit the job's namespace through the cluster; the
+        # KV copy lets get_runtime_context() resolve it INSIDE workers
         _runtime.cluster.default_namespace = namespace or ""
+        try:
+            _runtime.cluster.kv.dispatch(
+                "put", b"__job_namespace", (namespace or "").encode(),
+                "sys", True)
+        except Exception:   # noqa: BLE001 — identity metadata only
+            pass
         # the cluster carries the job-level default env: EVERY spec
         # intake (driver submits, worker-submitted children, actor
         # creation) merges against it, so inheritance is uniform —
@@ -593,6 +600,93 @@ def timeline(filename: str | None = None):
     if filename is not None:
         return events.dump_timeline(filename)
     return events.timeline()
+
+
+class RuntimeContext:
+    """Where am I running (reference: ``ray.get_runtime_context()`` /
+    ``RuntimeContext`` — job/task/actor/node identity)."""
+
+    def __init__(self, job_id=None, task_id=None, actor_id=None,
+                 node_id=None, namespace: str = ""):
+        self._job_id = job_id
+        self._task_id = task_id
+        self._actor_id = actor_id
+        self._node_id = node_id
+        self.namespace = namespace
+
+    def get_job_id(self):
+        return self._job_id
+
+    def get_task_id(self):
+        return self._task_id
+
+    def get_actor_id(self):
+        return self._actor_id
+
+    def get_node_id(self):
+        return self._node_id
+
+    def __repr__(self):
+        return (f"RuntimeContext(job={self._job_id}, "
+                f"task={self._task_id}, actor={self._actor_id}, "
+                f"node={self._node_id})")
+
+
+def get_runtime_context() -> RuntimeContext:
+    rt = _get_runtime()
+    from .runtime.worker import WorkerApiContext
+    if isinstance(rt, WorkerApiContext):    # inside a worker
+        tid = rt.current_task_id
+        aid_bin = rt.actor_id_bin
+        from .common.ids import ActorID
+        return RuntimeContext(
+            job_id=tid.job_id().hex() if tid is not None else None,
+            task_id=tid.hex() if tid is not None else None,
+            actor_id=(ActorID(aid_bin).hex() if aid_bin else None),
+            node_id=rt.node_id_hex,
+            namespace=_worker_namespace(rt))
+    if rt.is_driver:
+        head = rt.cluster.head()
+        return RuntimeContext(
+            job_id=rt.job_id.hex(), node_id=head.node_id.hex(),
+            namespace=rt.cluster.default_namespace)
+    # client mode: a connected driver — no task identity
+    return RuntimeContext(job_id=rt.job_id.hex(),
+                          namespace=getattr(rt, "namespace", "") or "")
+
+
+def _worker_namespace(rt) -> str:
+    """The job's default namespace, resolved from the GCS KV (workers
+    carry none of their own — api.init publishes it); cached after the
+    first lookup."""
+    ns = getattr(rt, "_cached_namespace", None)
+    if ns is None:
+        try:
+            raw = rt.kv_op("get", b"__job_namespace", namespace="sys")
+            ns = raw.decode() if raw else ""
+        except Exception:   # noqa: BLE001 — degraded KV: identity
+            ns = ""         # lookups must not raise
+        rt._cached_namespace = ns
+    return ns
+
+
+def list_named_actors(all_namespaces: bool = False) -> list[dict]:
+    """Live named actors (reference: ``ray.util.list_named_actors``):
+    ``[{"name", "namespace", "actor_id"}, ...]`` — the current
+    namespace's by default.  Works from drivers, workers, and
+    clients."""
+    rt = _get_runtime()
+    from .runtime.worker import WorkerApiContext
+    if isinstance(rt, WorkerApiContext):
+        # inside a worker: the listing rides a raylet frame
+        # (named_list), like the named_actor lookup does
+        ns = None if all_namespaces else _worker_namespace(rt)
+        return rt.list_named_actors_via_head(ns)
+    if not hasattr(rt, "cluster"):          # client mode: ask the head
+        return rt.list_named_actors(
+            all_namespaces, getattr(rt, "namespace", "") or "")
+    ns = None if all_namespaces else rt.cluster.default_namespace
+    return rt.actor_manager.list_named(ns)
 
 
 def worker_stacks(node_row: int | None = None,

@@ -105,16 +105,31 @@ class StandardAutoscaler:
         return {"launches": launches, "terminated": terminated,
                 "unmet": self.last_unmet}
 
+    def request_resources(self, bundles: list[dict]) -> None:
+        """Explicit demand floor (reference:
+        ``ray.autoscaler.sdk.request_resources``): the cluster sizes so
+        these bundles COULD schedule, immediately and independent of
+        live task load.  Each call REPLACES the previous request; an
+        empty list clears it."""
+        reqs = [ResourceRequest(b) for b in bundles]
+        with self._lock:
+            self._requested = reqs
+        self.kick()
+
     def _pending_demand(self) -> tuple[list[ResourceRequest], list[int]]:
         """Per-class pending demand: infeasible/queued tasks from every
         raylet plus the bundles of pending placement groups (reference:
-        ``LoadMetrics`` resource_demand + pending_placement_groups)."""
+        ``LoadMetrics`` resource_demand + pending_placement_groups)
+        plus any explicit ``request_resources`` floor."""
         by_class: dict = {}
         for raylet in list(self._cluster.raylets.values()):
             for req in raylet.pending_demand():
                 ent = by_class.setdefault(req.key(), [req, 0])
                 ent[1] += 1
         for req in self._cluster.pg_manager.pending_bundle_demand():
+            ent = by_class.setdefault(req.key(), [req, 0])
+            ent[1] += 1
+        for req in getattr(self, "_requested", ()):
             ent = by_class.setdefault(req.key(), [req, 0])
             ent[1] += 1
         reqs = [e[0] for e in by_class.values()]
@@ -193,9 +208,17 @@ class StandardAutoscaler:
         rows = [(row, r) for row, r in list(cluster.raylets.items())
                 if row != cluster._head_row]
         live_workers = len(rows)
+        requested = list(getattr(self, "_requested", ()))
         for row, raylet in rows:
             fully_free = bool(mask[row]) and \
                 (avail[row] == totals[row]).all()
+            if fully_free and requested and \
+                    not self._fits_without(row, requested):
+                # an explicit request_resources floor still needs this
+                # node's capacity: terminating would relaunch it next
+                # round (flap) and break the floor contract
+                self._idle_since.pop(raylet.node_id, None)
+                continue
             if fully_free and raylet.is_idle():
                 sole = cluster.directory.sole_copies_on(row)
                 if sole:
@@ -220,6 +243,29 @@ class StandardAutoscaler:
             else:
                 self._idle_since.pop(raylet.node_id, None)
         return terminated
+
+    def _fits_without(self, row: int, requested) -> bool:
+        """Would the explicit request floor still fit on AVAILABLE
+        capacity if ``row`` were terminated?  Greedy per-node bundle
+        fit (same granularity the launch packer uses)."""
+        import numpy as np
+        cluster = self._cluster
+        _totals, avail, mask = cluster.crm.arrays()
+        width = avail.shape[1]
+        remaining = {r: avail[r].astype(np.int64).copy()
+                     for r in cluster.raylets
+                     if r != row and mask[r]}
+        for req in requested:
+            vec = req.dense(cluster.crm.resource_index, width)
+            placed = False
+            for r, cap in remaining.items():
+                if (cap[:vec.shape[0]] >= vec).all():
+                    cap[:vec.shape[0]] -= vec
+                    placed = True
+                    break
+            if not placed:
+                return False
+        return True
 
     def _migrate_off(self, object_ids, row: int) -> None:
         """Pull sole-copy objects to the head so the node becomes safe to

@@ -1,0 +1,29 @@
+"""Programmatic autoscaler control.
+
+Reference parity: ``ray.autoscaler.sdk.request_resources``
+(``python/ray/autoscaler/sdk.py`` — SURVEY.md §1 layer 11; mount
+empty): command the cluster to scale so the given resource bundles
+could be scheduled, immediately and regardless of current load.  Each
+call replaces the previous request; ``request_resources()`` with no
+arguments clears it.
+"""
+
+from __future__ import annotations
+
+
+def request_resources(num_cpus: int | None = None,
+                      bundles: list[dict] | None = None) -> None:
+    from ray_tpu.api import _get_runtime
+    rt = _get_runtime()
+    cluster = getattr(rt, "cluster", None)
+    asc = getattr(cluster, "autoscaler", None) if cluster else None
+    if asc is None:
+        raise RuntimeError(
+            "no autoscaler is running — start one with "
+            "cluster.start_autoscaler(node_types)")
+    reqs: list[dict] = []
+    if num_cpus:
+        reqs.extend({"CPU": 1} for _ in range(int(num_cpus)))
+    for b in bundles or []:
+        reqs.append(dict(b))
+    asc.request_resources(reqs)

@@ -694,6 +694,21 @@ class ActorManager:
         with self._lock:
             return self._names.get((namespace or "", name))
 
+    def list_named(self, namespace: str | None = "") -> list[dict]:
+        """Live named actors (``ray.util.list_named_actors`` parity);
+        ``namespace=None`` lists every namespace."""
+        with self._lock:
+            out = []
+            for (ns, name), aid in self._names.items():
+                rec = self._actors.get(aid)
+                if rec is None or rec.state is ActorState.DEAD:
+                    continue
+                if namespace is not None and ns != (namespace or ""):
+                    continue
+                out.append({"name": name, "namespace": ns,
+                            "actor_id": aid.hex()})
+            return out
+
     def on_job_exit(self, job_bin: bytes) -> None:
         """A driver/client job ended: its EPHEMERAL actors die with it;
         detached actors live until explicitly killed (reference:

@@ -147,6 +147,7 @@ class HeadNode:
             "state_list": self._state_list,
             "memory": self._memory,
             "worker_stacks": self._worker_stacks,
+            "list_named_actors": self._list_named_actors,
             "job_submit": self.jobs.submit,
             "job_status": self.jobs.status,
             "job_list": self.jobs.list,
@@ -158,6 +159,13 @@ class HeadNode:
     # -- client-mode surface -------------------------------------------------
     def _ping(self) -> dict:
         return {"ok": True, "session_dir": self._rt.cluster.session_dir}
+
+    def _list_named_actors(self, all_namespaces: bool = False,
+                           namespace: str = "") -> list:
+        """Filters by the CALLING client's namespace (it rides the
+        RPC), never the head driver's."""
+        ns = None if all_namespaces else (namespace or "")
+        return self._rt.actor_manager.list_named(ns)
 
     def _worker_stacks(self, row: int | None = None,
                        timeout: float = 5.0) -> dict:

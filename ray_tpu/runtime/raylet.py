@@ -155,6 +155,7 @@ class Raylet:
             arena_path=(arena.path if arena and not inline_objects
                         else None),
             spawner=spawner)
+        self.pool.node_id_hex = node_id.hex()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"raylet-{self.row}")
 
@@ -1704,6 +1705,11 @@ class Raylet:
             self.cluster.stream_ack(TaskID(msg[1]), msg[2])
         elif kind == "stream_close_up":
             self.cluster.stream_close(TaskID(msg[1]), msg[2])
+        elif kind == "named_list":
+            am2 = self.actor_manager
+            worker.send(("named_list_reply",
+                         am2.list_named(msg[1])
+                         if am2 is not None else []))
         elif kind == "stacks_reply":
             # live stack sample answered by the worker's reader thread
             self.cluster._on_stacks_reply(msg[1], self.row,

@@ -43,7 +43,7 @@ from .serialization import RayTaskError, deserialize, serialize
 # reply frame kinds the reader routes to the API reply queue
 _REPLY_KINDS = frozenset({"get_reply", "get_reply_x", "wait_reply",
                           "kv_reply", "named_actor_reply",
-                          "stream_wait_reply"})
+                          "named_list_reply", "stream_wait_reply"})
 
 
 def _format_all_stacks() -> str:
@@ -137,6 +137,9 @@ class WorkerApiContext:
         self._stream_active: set[bytes] = set()
         self._stream_cancelled: set[bytes] = set()
         self._stream_cv = threading.Condition()
+        # runtime-context identity (reference: ray.get_runtime_context)
+        self.node_id_hex: str | None = None     # fed by "node_info"
+        self.actor_id_bin: bytes | None = None  # set at actor_new
 
     # -- transport ----------------------------------------------------------
     def send(self, msg) -> None:
@@ -163,6 +166,11 @@ class WorkerApiContext:
                                _format_all_stacks()))
                 except Exception:   # noqa: BLE001 — diagnostics only
                     pass
+            elif msg[0] == "node_info":
+                # which node hosts this worker (runtime-context
+                # surface) — set from the reader so it is visible even
+                # while the main thread executes a long task
+                self.node_id_hex = msg[1]
             elif msg[0] == "stream_ack":
                 # out-of-band: the main thread is inside the generator.
                 # Only ACTIVE streams record (a late ack after
@@ -443,6 +451,13 @@ class WorkerApiContext:
         with self._api_lock:
             self.send(("named_actor", name, namespace))
             return self._recv_reply("named_actor_reply")[1]
+
+    def list_named_actors_via_head(self, namespace):
+        """Named-actor listing from inside a task/actor (None = every
+        namespace)."""
+        with self._api_lock:
+            self.send(("named_list", namespace))
+            return self._recv_reply("named_list_reply")[1]
 
 
 class _ActorExecutor:
@@ -808,6 +823,7 @@ def worker_main(conn, worker_index: int,
                 args = kwargs = out = results = payloads = r = None
         elif kind == "actor_new":
             _, actor_id_bin, cls_id, payload = msg
+            ctx.actor_id_bin = actor_id_bin
             unpacked = deserialize(payload)
             if len(unpacked) == 3:
                 args, kwargs, concurrency = unpacked

@@ -144,6 +144,7 @@ class WorkerPool:
         # gate, and its ready must not release a gate a concurrent
         # ensure_env_worker spawn still holds
         self._env_spawning: dict = {}
+        self.node_id_hex: str | None = None     # set by the raylet
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -227,6 +228,10 @@ class WorkerPool:
             except (EOFError, OSError):
                 break
             if msg[0] == "ready":
+                if self.node_id_hex:
+                    # runtime-context identity: tell the worker which
+                    # node hosts it (reference: RuntimeContext.node_id)
+                    handle.send(("node_info", self.node_id_hex))
                 if not handle.dedicated and handle.env_key is not None:
                     with self._lock:
                         # boot done: reopen the env gate — but only OUR

@@ -183,6 +183,13 @@ class ClientRuntime:
         return self._call("kv", op, key, value, namespace, overwrite)
 
     # -- introspection (api module functions duck-type onto these) -----------
+    def list_named_actors(self, all_namespaces: bool = False,
+                          namespace: str = "") -> list:
+        # the CALLER's namespace rides along: the head must filter by
+        # it, not by its own driver's
+        return self._call("list_named_actors", all_namespaces,
+                          namespace)
+
     def worker_stacks(self, node_row: int | None = None,
                       timeout: float = 5.0) -> dict:
         return self._call("worker_stacks", node_row, timeout,

@@ -168,3 +168,28 @@ class TestDeviceRouting:
         finally:
             ray_tpu.shutdown()
             c.stop()
+
+
+class TestRequestResourcesSdk:
+    def test_explicit_request_launches_and_clears(self, small_cluster):
+        """ray.autoscaler.sdk.request_resources parity: an explicit
+        bundle floor launches capacity with NO live task demand, and
+        clearing it stops influencing later rounds."""
+        from ray_tpu.autoscaler.sdk import request_resources
+        c = small_cluster
+        asc = c.start_autoscaler(TYPES, interval_ms=60_000)
+        # floor: 6 CPUs of bundles on a 2-CPU cluster -> launch
+        request_resources(bundles=[{"CPU": 2}] * 3)
+        assert _wait_until(lambda: len(c.raylets) >= 2, timeout=30), \
+            len(c.raylets)
+        # clearing the request: no further launches from it
+        request_resources()
+        before = len(c.raylets)
+        asc.kick()
+        time.sleep(1.0)
+        assert len(c.raylets) == before
+
+    def test_request_without_autoscaler_raises(self, small_cluster):
+        from ray_tpu.autoscaler.sdk import request_resources
+        with pytest.raises(RuntimeError, match="no autoscaler"):
+            request_resources(num_cpus=4)

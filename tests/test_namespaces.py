@@ -174,3 +174,73 @@ class TestDetachedLifetime:
             assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
         finally:
             head.stop()
+
+
+class TestRuntimeContextAndNamedListing:
+    def test_runtime_context_identities(self, driver):
+        ctx = ray_tpu.get_runtime_context()
+        assert ctx.get_job_id() and ctx.get_node_id()
+        assert ctx.get_task_id() is None    # driver, not a task
+
+        @ray_tpu.remote
+        def who():
+            c = ray_tpu.get_runtime_context()
+            return (c.get_task_id(), c.get_job_id(), c.get_node_id(),
+                    c.get_actor_id())
+
+        tid, jid, nid, aid = ray_tpu.get(who.remote(), timeout=60)
+        assert tid and jid and nid
+        assert aid is None                  # plain task, not an actor
+        assert nid == ctx.get_node_id()     # same (head) node
+
+        @ray_tpu.remote
+        class Who:
+            def who(self):
+                c = ray_tpu.get_runtime_context()
+                return c.get_actor_id(), c.get_node_id()
+
+        a = Who.remote()
+        aid2, nid2 = ray_tpu.get(a.who.remote(), timeout=60)
+        assert aid2 and nid2
+        assert aid2 == a._actor_id.hex()
+        ray_tpu.kill(a)
+
+    def test_list_named_actors(self, driver):
+        @ray_tpu.remote
+        class N:
+            def ping(self):
+                return "ok"
+
+        a = N.options(name="listed-a").remote()
+        b = N.options(name="listed-b", namespace="other").remote()
+        ray_tpu.get([a.ping.remote(), b.ping.remote()], timeout=60)
+        names = {r["name"] for r in ray_tpu.list_named_actors()}
+        assert "listed-a" in names and "listed-b" not in names
+        every = {(r["namespace"], r["name"])
+                 for r in ray_tpu.list_named_actors(
+                     all_namespaces=True)}
+        # the module driver inits with namespace="testns"
+        assert ("testns", "listed-a") in every and \
+            ("other", "listed-b") in every
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+    def test_worker_namespace_and_listing(self, driver):
+        @ray_tpu.remote
+        class Named:
+            def ping(self):
+                return "ok"
+
+        n = Named.options(name="ctx-listed").remote()
+        ray_tpu.get(n.ping.remote(), timeout=60)
+
+        @ray_tpu.remote
+        def inside():
+            c = ray_tpu.get_runtime_context()
+            rows = ray_tpu.list_named_actors()
+            return c.namespace, {r["name"] for r in rows}
+
+        ns, names = ray_tpu.get(inside.remote(), timeout=60)
+        assert ns == "testns"           # the module driver's namespace
+        assert "ctx-listed" in names    # listed from INSIDE a worker
+        ray_tpu.kill(n)
