@@ -197,12 +197,60 @@ class ActorClass:
             # None (not "") from a WORKER runtime: the raylet fills in
             # the job's default namespace cluster-side
             namespace = getattr(rt, "namespace", None)
-        rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
-                        max_restarts, max_task_retries, name, resources,
-                        strategy, opts.get("runtime_env"),
-                        concurrency=concurrency, namespace=namespace,
-                        lifetime=lifetime)
+        get_if_exists = bool(opts.get("get_if_exists"))
+        if get_if_exists:
+            # get-or-create (reference: options(get_if_exists=True)):
+            # reuse a live actor under this name, else create; races
+            # resolve by re-looking-up the REGISTRY's winner below
+            if not name:
+                raise ValueError("get_if_exists requires a name")
+            existing = _lookup_existing(name, namespace)
+            if existing is not None:
+                return existing
+        try:
+            rt.create_actor(actor_id, cls_id, cls_bytes, args, kwargs,
+                            max_restarts, max_task_retries, name,
+                            resources, strategy,
+                            opts.get("runtime_env"),
+                            concurrency=concurrency,
+                            namespace=namespace, lifetime=lifetime)
+        except Exception:
+            # a name-collision loss surfaces as ValueError in-process
+            # but as RemoteRpcError through a client — any failure
+            # under get_if_exists resolves to the winner if one exists
+            if get_if_exists:
+                existing = _lookup_existing(name, namespace)
+                if existing is not None:
+                    return existing
+            raise
+        if get_if_exists:
+            # async runtimes (a worker's create frame is fire-and-
+            # forget): the NAME registry is the authority on who won a
+            # race — return whatever it resolves to once registration
+            # lands, which is our own handle in the common case
+            win = _await_named(name, namespace, timeout=10.0)
+            if win is not None:
+                return win
         return ActorHandle(actor_id)
+
+
+def _lookup_existing(name: str, namespace) -> "ActorHandle | None":
+    from . import api
+    try:
+        return api.get_actor(name, namespace=namespace)
+    except ValueError:
+        return None
+
+
+def _await_named(name: str, namespace,
+                 timeout: float = 10.0) -> "ActorHandle | None":
+    import time as _time
+    deadline = _time.monotonic() + timeout
+    while True:
+        got = _lookup_existing(name, namespace)
+        if got is not None or _time.monotonic() >= deadline:
+            return got
+        _time.sleep(0.05)
 
 
 def make_actor_class(cls: type, options: dict[str, Any]) -> ActorClass:

@@ -663,9 +663,11 @@ def _worker_namespace(rt) -> str:
     if ns is None:
         try:
             raw = rt.kv_op("get", b"__job_namespace", namespace="sys")
-            ns = raw.decode() if raw else ""
         except Exception:   # noqa: BLE001 — degraded KV: identity
-            ns = ""         # lookups must not raise
+            return ""       # lookups must not raise, and a TRANSIENT
+            #                 failure must not cache a wrong ''
+            #                 forever — retry next call
+        ns = raw.decode() if raw else ""
         rt._cached_namespace = ns
     return ns
 

@@ -248,7 +248,6 @@ class StandardAutoscaler:
         """Would the explicit request floor still fit on AVAILABLE
         capacity if ``row`` were terminated?  Greedy per-node bundle
         fit (same granularity the launch packer uses)."""
-        import numpy as np
         cluster = self._cluster
         _totals, avail, mask = cluster.crm.arrays()
         width = avail.shape[1]

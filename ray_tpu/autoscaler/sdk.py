@@ -14,16 +14,24 @@ from __future__ import annotations
 def request_resources(num_cpus: int | None = None,
                       bundles: list[dict] | None = None) -> None:
     from ray_tpu.api import _get_runtime
-    rt = _get_runtime()
-    cluster = getattr(rt, "cluster", None)
-    asc = getattr(cluster, "autoscaler", None) if cluster else None
-    if asc is None:
-        raise RuntimeError(
-            "no autoscaler is running — start one with "
-            "cluster.start_autoscaler(node_types)")
     reqs: list[dict] = []
     if num_cpus:
         reqs.extend({"CPU": 1} for _ in range(int(num_cpus)))
     for b in bundles or []:
         reqs.append(dict(b))
-    asc.request_resources(reqs)
+    rt = _get_runtime()
+    cluster = getattr(rt, "cluster", None)
+    if cluster is not None:                 # in-process driver
+        asc = cluster.autoscaler
+        if asc is None:
+            raise RuntimeError(
+                "no autoscaler is running — start one with "
+                "cluster.start_autoscaler(node_types)")
+        asc.request_resources(reqs)
+        return
+    if hasattr(rt, "request_resources"):    # client mode: head RPC
+        rt.request_resources(reqs)
+        return
+    raise RuntimeError(
+        "request_resources is callable from the driver or a connected "
+        "client; worker-side calls are not supported")

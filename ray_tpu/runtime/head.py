@@ -148,6 +148,7 @@ class HeadNode:
             "memory": self._memory,
             "worker_stacks": self._worker_stacks,
             "list_named_actors": self._list_named_actors,
+            "request_resources": self._request_resources,
             "job_submit": self.jobs.submit,
             "job_status": self.jobs.status,
             "job_list": self.jobs.list,
@@ -159,6 +160,13 @@ class HeadNode:
     # -- client-mode surface -------------------------------------------------
     def _ping(self) -> dict:
         return {"ok": True, "session_dir": self._rt.cluster.session_dir}
+
+    def _request_resources(self, bundles: list[dict]) -> bool:
+        asc = self._rt.cluster.autoscaler
+        if asc is None:
+            raise RuntimeError("no autoscaler is running on this head")
+        asc.request_resources(bundles)
+        return True
 
     def _list_named_actors(self, all_namespaces: bool = False,
                            namespace: str = "") -> list:

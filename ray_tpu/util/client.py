@@ -183,6 +183,9 @@ class ClientRuntime:
         return self._call("kv", op, key, value, namespace, overwrite)
 
     # -- introspection (api module functions duck-type onto these) -----------
+    def request_resources(self, bundles: list[dict]) -> None:
+        self._call("request_resources", bundles)
+
     def list_named_actors(self, all_namespaces: bool = False,
                           namespace: str = "") -> list:
         # the CALLER's namespace rides along: the head must filter by

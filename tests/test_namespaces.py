@@ -244,3 +244,31 @@ class TestRuntimeContextAndNamedListing:
         assert ns == "testns"           # the module driver's namespace
         assert "ctx-listed" in names    # listed from INSIDE a worker
         ray_tpu.kill(n)
+
+
+class TestGetIfExists:
+    def test_get_or_create(self, driver):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.options(name="goc", get_if_exists=True).remote()
+        assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+        # second call REUSES: same instance state, no collision error
+        b = Counter.options(name="goc", get_if_exists=True).remote()
+        assert b._actor_id == a._actor_id
+        assert ray_tpu.get(b.inc.remote(), timeout=60) == 2
+        ray_tpu.kill(a)
+
+    def test_requires_name(self, driver):
+        @ray_tpu.remote
+        class X:
+            pass
+
+        with pytest.raises(ValueError, match="requires a name"):
+            X.options(get_if_exists=True).remote()
