@@ -188,7 +188,8 @@ class RemoteFunction:
                  name: str | None = None, num_returns: int = 1,
                  resources: dict[str, float] | None = None,
                  max_retries: int | None = None, fn_id: str | None = None,
-                 strategy=None, runtime_env: dict | None = None):
+                 strategy=None, runtime_env: dict | None = None,
+                 max_calls: int = 0):
         if fn is None and fn_bytes is None and fn_id is None:
             raise ValueError("need a function, its bytes, or its id")
         self._fn = fn
@@ -199,6 +200,7 @@ class RemoteFunction:
         self._max_retries = max_retries
         self._strategy = strategy or DEFAULT_STRATEGY
         self._runtime_env = runtime_env
+        self._max_calls = int(max_calls or 0)
         # The id is decoration-time random, NOT a content hash: a recursive
         # remote function's bytes contain its own wrapper, whose pickle
         # embeds the id — a content hash would be circular (reference keys
@@ -214,7 +216,8 @@ class RemoteFunction:
                 scheduling_strategy=None,
                 placement_group=None,
                 placement_group_bundle_index: int = -1,
-                runtime_env: dict | None = None) -> "RemoteFunction":
+                runtime_env: dict | None = None,
+                max_calls: int | None = None) -> "RemoteFunction":
         res = dict(resources) if resources is not None \
             else dict(self._resources)
         if num_cpus is not None:
@@ -230,7 +233,9 @@ class RemoteFunction:
             fn_id=self._fn_id,     # same function => same registry entry
             strategy=strategy,
             runtime_env=(runtime_env if runtime_env is not None
-                         else self._runtime_env))
+                         else self._runtime_env),
+            max_calls=(max_calls if max_calls is not None
+                       else self._max_calls))
 
     # -- serialization (registry + shipping) --------------------------------
     def _materialize(self) -> tuple[str, bytes | None]:
@@ -261,7 +266,7 @@ class RemoteFunction:
         return (RemoteFunction,
                 (None, None, self._name, self._num_returns,
                  self._resources, self._max_retries, self._fn_id,
-                 self._strategy, self._runtime_env))
+                 self._strategy, self._runtime_env, self._max_calls))
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -315,7 +320,8 @@ class RemoteFunction:
             strategy=self._strategy, max_retries=retries,
             runtime_env=self._runtime_env,  # the job-level env merges in
             #                                 at the raylet submit intake
-            trace_ctx=context_for_new_task(task_id))
+            trace_ctx=context_for_new_task(task_id),
+            max_calls=self._max_calls)
         if num_returns == -1:
             from .runtime.object_ref import ObjectRefGenerator
             rt.submit_spec(spec, fn_id, fn_bytes)
@@ -351,7 +357,8 @@ def remote(*args, **options):
                 options.get("scheduling_strategy"),
                 options.get("placement_group"),
                 options.get("placement_group_bundle_index", -1), None),
-            runtime_env=options.get("runtime_env"))
+            runtime_env=options.get("runtime_env"),
+            max_calls=options.get("max_calls", 0))
     return wrap
 
 

@@ -87,6 +87,10 @@ class TaskSpec:
     dependencies: tuple = ()
     # retry bookkeeping (mutated by TaskManager)
     attempt_number: int = 0
+    # worker recycling (reference @ray.remote(max_calls=N)): the
+    # executing worker retires after this many invocations of the
+    # function — the pressure valve for tasks that leak native memory
+    max_calls: int = 0
 
     def scheduling_class(self) -> tuple:
         """Interned identity for batch grouping — equal classes are

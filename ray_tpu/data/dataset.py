@@ -224,6 +224,21 @@ class Dataset:
         if carry:
             yield np.asarray(carry)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtype=None, device=None):
+        """``iter_batches`` as torch tensors (reference:
+        ``Dataset.iter_torch_batches``) — the torch-training ingest
+        hook; conversion is zero-copy from the numpy batch where
+        dtypes allow."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size):
+            # iter_batches yields fresh contiguous arrays; one fused
+            # .to(device, dtype) avoids a second full-batch copy
+            t = torch.from_numpy(batch)
+            if dtype is not None or device is not None:
+                t = t.to(device=device, dtype=dtype)
+            yield t
+
     def num_blocks(self) -> int:
         return len(self._blocks)
 

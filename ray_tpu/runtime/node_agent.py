@@ -739,7 +739,9 @@ class NodeAgent:
         from .serialization import deserialize
         spec = deserialize(spec_bytes)
         if spec.strategy.kind is not SchedulingStrategyKind.DEFAULT \
-                or spec.runtime_env or spec.num_returns < 0:
+                or spec.runtime_env or spec.num_returns < 0 \
+                or getattr(spec, "max_calls", 0) > 0:
+            # max_calls recycling is head-pool bookkeeping: relay
             return False
         from .object_store import PLASMA_KINDS
         for a in spec.args:

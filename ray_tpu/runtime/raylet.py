@@ -1544,6 +1544,19 @@ class Raylet:
                     self._seal_error_returns(rec, deserialize(msg[2]))
                 self.task_manager.complete(task_id)
                 self.crm.add_back(self.row, rec.spec.resources)
+            # max_calls worker recycling (reference: the executing
+            # worker retires after N calls of the function — the
+            # pressure valve for native-memory leaks): kill instead of
+            # reuse; the pool's death-respawn replaces it and recalls
+            # any pipelined tasks
+            if rec is not None and rec.spec.max_calls > 0 \
+                    and not worker.dedicated:
+                fd = rec.spec.function_descriptor
+                worker.fn_calls[fd] = worker.fn_calls.get(fd, 0) + 1
+                if worker.fn_calls[fd] >= rec.spec.max_calls:
+                    self.pool.kill_worker(worker)
+                    self._notify_dirty()
+                    return
             # pipelined lease: ship the next committed task from THIS
             # reader thread before anything else can steal the worker;
             # with no committed entry, chain straight into the oldest

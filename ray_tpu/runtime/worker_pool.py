@@ -97,6 +97,8 @@ class WorkerHandle:
         # the owning raylet's _cv.
         self.assigned: deque = deque()
         self.fn_cache: set[str] = set()
+        # per-function execution counts (max_calls worker recycling)
+        self.fn_calls: dict[str, int] = {}
         # FIFO of shm-pin batches for get replies in flight to this
         # worker; drained by its get_ack frames, or by death/drain
         # cleanup (which may run on another thread — hence the lock and

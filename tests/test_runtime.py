@@ -188,3 +188,27 @@ class TestWorkerSideWait:
         time.sleep(0.5)                 # let fast finish, slow still running
         assert ray_tpu.get(prober.remote([s], [f]), timeout=30) == "ok"
         ray_tpu.cancel(s, force=True)
+
+
+class TestMaxCalls:
+    def test_worker_recycles_after_max_calls(self, rt):
+        """@remote(max_calls=2): the executing worker process retires
+        after 2 invocations (the native-leak pressure valve) and the
+        pool replaces it — pids change across call pairs, and
+        unrelated tasks keep running."""
+        import os as _os
+
+        @ray_tpu.remote(max_calls=2)
+        def leaky():
+            return _os.getpid()
+
+        pids = [ray_tpu.get(leaky.remote(), timeout=60)
+                for _ in range(6)]
+        # 6 calls at max_calls=2 must span >= 3 distinct processes
+        assert len(set(pids)) >= 3, pids
+
+        @ray_tpu.remote
+        def normal():
+            return "ok"
+
+        assert ray_tpu.get(normal.remote(), timeout=60) == "ok"
