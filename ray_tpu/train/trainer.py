@@ -259,7 +259,9 @@ class JaxTrainer:
                         remove_placement_group(pg)
                         pg = None
                         import time as _time
-                        _time.sleep(0.5)    # let the release land
+                        _time.sleep(1.0)    # resource release from the
+                        # dead attempt's actors + bundles is async —
+                        # measuring too early under-counts capacity
                     world = max(min(n_target,
                                     self._placeable_workers(res)),
                                 n_min)

@@ -225,18 +225,23 @@ class TestElasticTraining:
 
         with tempfile.TemporaryDirectory() as td:
             marker = os.path.join(td, "crashed")
-            # the hog must outlive the whole crash->restart window even
-            # on a heavily loaded CI box; it is cancelled afterwards
-            hog_ref = hog.remote(120.0)
-            result = train.JaxTrainer(
-                loop,
-                train_loop_config={"marker": marker},
-                scaling_config=train.ScalingConfig(
-                    num_workers=3, min_workers=1),
-                failure_config=train.FailureConfig(max_failures=2),
-            ).fit(timeout=120)
+            # the hog outlives ANY retry window (cancelled in the
+            # finally — never leaked past the test, and no late
+            # full-capacity attempt can sneak in and complete at
+            # world=3).  max_failures has headroom: actor spawn under
+            # load can burn an extra attempt before the shrink lands
+            hog_ref = hog.remote(3600.0)
+            try:
+                result = train.JaxTrainer(
+                    loop,
+                    train_loop_config={"marker": marker},
+                    scaling_config=train.ScalingConfig(
+                        num_workers=3, min_workers=1),
+                    failure_config=train.FailureConfig(max_failures=4),
+                ).fit(timeout=180)
+            finally:
+                ray_tpu.cancel(hog_ref, force=True)
             assert os.path.exists(marker)
-            ray_tpu.cancel(hog_ref, force=True)
         assert result.metrics["step"] == 3
         assert result.metrics["resumed_from"] == 2   # from checkpoint
         # the completing attempt ran SMALLER than the original gang
