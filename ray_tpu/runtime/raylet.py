@@ -843,6 +843,17 @@ class Raylet:
                         rec, "placement group removed, unknown, or "
                         "bundle index out of range")
                     continue
+            if strat.kind is SchedulingStrategyKind.NODE_AFFINITY \
+                    and not strat.soft \
+                    and self.crm.row_of(strat.node_id) is None:
+                # hard affinity to a node that no longer exists can
+                # NEVER place — fail fast instead of parking forever
+                # (reference: hard NodeAffinity to a dead node fails
+                # the task as unschedulable)
+                self._fail_unscheduled(
+                    rec, "hard node affinity to a dead or unknown "
+                    f"node {strat.node_id.hex()[:12]}")
+                continue
             recs.append(rec)
         if not recs:
             return []

@@ -258,13 +258,21 @@ class JaxTrainer:
                     if pg is not None:
                         remove_placement_group(pg)
                         pg = None
-                        import time as _time
-                        _time.sleep(1.0)    # resource release from the
-                        # dead attempt's actors + bundles is async —
-                        # measuring too early under-counts capacity
-                    world = max(min(n_target,
-                                    self._placeable_workers(res)),
-                                n_min)
+                    # resource release from the dead attempt's actors
+                    # and bundles is ASYNC: poll until the fit result
+                    # covers the target or stabilizes (two equal
+                    # readings) — no blind sleep, no measuring early
+                    import time as _time
+                    deadline = _time.monotonic() + 5.0
+                    fits = self._placeable_workers(res)
+                    while fits < n_target and \
+                            _time.monotonic() < deadline:
+                        _time.sleep(0.1)
+                        again = self._placeable_workers(res)
+                        if again == fits and again > 0:
+                            break
+                        fits = again
+                    world = max(min(n_target, fits), n_min)
                     if world != pg_size:
                         log.warning(
                             "elastic gang resize: %d -> %d workers",

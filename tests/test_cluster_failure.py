@@ -31,3 +31,45 @@ class TestNodeFailure:
         finally:
             ray_tpu.shutdown()
             c.stop()
+
+
+class TestHardAffinityToDeadNode:
+    def test_fails_fast_instead_of_parking(self):
+        """A HARD NodeAffinity task whose target node no longer exists
+        fails loudly as unschedulable (reference semantics) — both a
+        fresh submit and a lineage-recovery resubmit; parking forever
+        would hang every waiter."""
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.runtime.serialization import RayTaskError
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        c = Cluster()
+        c.add_node(resources={"CPU": 2, "memory": 2}, num_workers=1)
+        n2 = c.add_node(resources={"CPU": 2, "memory": 2},
+                        num_workers=1)
+        ray_tpu.init(cluster=c)
+        try:
+            @ray_tpu.remote
+            def produce():
+                return bytes(300_000)
+
+            pinned = produce.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    n2, soft=False))
+            ref = pinned.remote()
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+            assert ready, "producer never sealed on n2"
+            c.remove_node(n2)
+            # the sole copy died with the node; recovery resubmits the
+            # retryable task, whose hard pin now names a dead node
+            with pytest.raises(Exception) as ei:
+                ray_tpu.get(ref, timeout=30)
+            assert "dead or unknown node" in str(ei.value) \
+                or "lost" in str(ei.value), ei.value
+            # a FRESH submit pinned to the dead node fails fast too
+            ref2 = pinned.remote()
+            with pytest.raises(RayTaskError, match="dead or unknown"):
+                ray_tpu.get(ref2, timeout=30)
+        finally:
+            ray_tpu.shutdown()
+            c.stop()
