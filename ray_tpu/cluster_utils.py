@@ -378,6 +378,15 @@ class Cluster:
                     f"{node_id.hex()[:12]}")))
         self.pg_manager.on_node_removed(row)
         raylet.drain_for_removal(self.head())
+        # wake every SURVIVING raylet: a task parked infeasible behind
+        # a pin/label on the removed node must re-reach placement so
+        # the dead-node fail-fast (or a re-place) fires — membership
+        # changes re-trigger scheduling in both directions, like
+        # add_node's wake (reference: the resource broadcast)
+        with self._lock:
+            others = list(self.raylets.values())
+        for r in others:
+            r._notify_dirty()
 
     def start_autoscaler(self, node_types, **kwargs) -> "StandardAutoscaler":
         """Attach + start the autoscaler runtime loop (reference:

@@ -259,20 +259,22 @@ class JaxTrainer:
                         remove_placement_group(pg)
                         pg = None
                     # resource release from the dead attempt's actors
-                    # and bundles is ASYNC: poll until the fit result
-                    # covers the target or stabilizes (two equal
-                    # readings) — no blind sleep, no measuring early
+                    # and bundles is ASYNC: the first sample comes
+                    # AFTER a sleep (a t=0 reading predates the
+                    # release), then poll until the fit covers the
+                    # target or two consecutive post-sleep readings
+                    # agree
                     import time as _time
                     deadline = _time.monotonic() + 5.0
-                    fits = self._placeable_workers(res)
-                    while fits < n_target and \
-                            _time.monotonic() < deadline:
-                        _time.sleep(0.1)
+                    fits = -1
+                    while _time.monotonic() < deadline:
+                        _time.sleep(0.2)
                         again = self._placeable_workers(res)
-                        if again == fits and again > 0:
+                        if again >= n_target or again == fits:
+                            fits = again
                             break
                         fits = again
-                    world = max(min(n_target, fits), n_min)
+                    world = max(min(n_target, max(fits, 0)), n_min)
                     if world != pg_size:
                         log.warning(
                             "elastic gang resize: %d -> %d workers",

@@ -73,3 +73,47 @@ class TestHardAffinityToDeadNode:
         finally:
             ray_tpu.shutdown()
             c.stop()
+
+    def test_parked_pin_fails_when_target_dies_later(self):
+        """A hard-pinned task parked because its target node is FULL
+        must fail fast when that node later DIES — node removal wakes
+        surviving raylets so parked queues re-reach placement."""
+        import time as _time
+
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.runtime.serialization import RayTaskError
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        c = Cluster()
+        c.add_node(resources={"CPU": 2, "memory": 2}, num_workers=1)
+        n2 = c.add_node(resources={"CPU": 1, "memory": 1},
+                        num_workers=1)
+        ray_tpu.init(cluster=c)
+        try:
+            @ray_tpu.remote
+            def hold(dt):
+                _time.sleep(dt)
+                return "held"
+
+            @ray_tpu.remote(resources={"CPU": 1, "memory": 1})
+            def wants_n2():
+                return "ran"
+
+            # fill n2 completely so the pinned task parks infeasible
+            blocker = hold.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    n2, soft=False)).remote(3600)
+            _time.sleep(0.5)
+            parked = wants_n2.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    n2, soft=False)).remote()
+            _time.sleep(0.5)        # let it park behind the full node
+            c.remove_node(n2)
+            with pytest.raises(Exception) as ei:
+                ray_tpu.get(parked, timeout=30)
+            assert "dead or unknown" in str(ei.value) \
+                or "node" in str(ei.value), ei.value
+            del blocker
+        finally:
+            ray_tpu.shutdown()
+            c.stop()

@@ -304,3 +304,34 @@ class TestMultiAgentTopology:
             a1.stop()
             a2.stop()
             _wait_nodes(1)
+
+
+class TestAgentStreaming:
+    def test_generator_on_agent_streams_big_items(self, head, agent):
+        """A streaming-generator task RUNNING ON THE AGENT: big yielded
+        items seal into the agent arena (stream_item_x metadata rides
+        up), the driver's ObjectRefGenerator pulls them over the object
+        plane, and backpressure acks flow back through the relay."""
+        @ray_tpu.remote(num_returns="streaming",
+                        resources={"CPU": 1, "remote_slot": 1})
+        def produce(n, size):
+            for i in range(n):
+                yield bytes([i % 251]) * size
+
+        n, size = 6, 300_000        # plasma-routed items
+        gen = produce.remote(n, size)
+        got = []
+        for ref in gen:
+            got.append(ray_tpu.get(ref, timeout=120))
+        assert [len(b) for b in got] == [size] * n
+        assert [b[0] for b in got] == [i % 251 for i in range(n)]
+
+    def test_generator_on_agent_small_items(self, head, agent):
+        @ray_tpu.remote(num_returns="streaming",
+                        resources={"CPU": 1, "remote_slot": 1})
+        def counts(n):
+            for i in range(n):
+                yield i * 3
+
+        vals = [ray_tpu.get(r, timeout=60) for r in counts.remote(10)]
+        assert vals == [i * 3 for i in range(10)]
