@@ -271,10 +271,7 @@ class Cluster:
         # wake every existing raylet: tasks parked as infeasible may now
         # have a feasible node (reference: node arrival triggers a
         # scheduling round on every raylet via the resource broadcast)
-        with self._lock:
-            others = [r for r in self.raylets.values() if r is not raylet]
-        for r in others:
-            r._notify_dirty()
+        self.wake_raylets(exclude=raylet)
         return node_id
 
     def add_remote_node(self, resources: dict[str, float] | None = None,
@@ -383,9 +380,16 @@ class Cluster:
         # the dead-node fail-fast (or a re-place) fires — membership
         # changes re-trigger scheduling in both directions, like
         # add_node's wake (reference: the resource broadcast)
+        self.wake_raylets()
+
+    def wake_raylets(self, exclude=None) -> None:
+        """Re-trigger every raylet's scheduling loop (cluster
+        membership/resource events): snapshot under the lock, notify
+        outside it."""
         with self._lock:
-            others = list(self.raylets.values())
-        for r in others:
+            raylets = [r for r in self.raylets.values()
+                       if r is not exclude]
+        for r in raylets:
             r._notify_dirty()
 
     def start_autoscaler(self, node_types, **kwargs) -> "StandardAutoscaler":

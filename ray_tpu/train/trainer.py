@@ -270,7 +270,11 @@ class JaxTrainer:
                     while _time.monotonic() < deadline:
                         _time.sleep(0.2)
                         again = self._placeable_workers(res)
-                        if again >= n_target or again == fits:
+                        if again >= n_target or \
+                                (again == fits and again > 0):
+                            # a transient 0 is never "stable": the
+                            # release may still be landing — keep
+                            # polling to the deadline
                             fits = again
                             break
                         fits = again
