@@ -1,0 +1,75 @@
+"""Graceful-drain benchmark: drain-to-empty latency under load.
+
+Measures the DRAINING state machine end to end: a worker node running a
+stream of short tasks receives a drain notice; the clock runs from
+``drain_node`` returning (node already masked, zero new leases) to the
+monitor declaring it empty and removing it — running tasks finishing,
+queued work resubmitting elsewhere, and sole-copy objects migrating all
+land inside the window.  ``vs_baseline`` compares against the blunt
+alternative (killing the node and letting every in-flight task burn a
+retry): the deadline a drain saves is the task tail it did NOT re-run.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+ROUNDS = 5
+N_TASKS = 32
+TASK_S = 0.05
+
+
+def _one_round(ray_tpu, cluster, work):
+    node = cluster.add_node(resources={"CPU": 4, "memory": 4},
+                            num_workers=2)
+    refs = [work.remote(i) for i in range(N_TASKS)]
+    time.sleep(4 * TASK_S)              # the node is mid-backlog
+    t0 = time.perf_counter()
+    cluster.drain_node(node, reason="bench", deadline_s=60.0)
+    fin = cluster.wait_for_drain(node, timeout=120)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    assert fin["outcome"] == "drained", fin
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == list(range(N_TASKS))
+    return elapsed_ms
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.api import _get_runtime
+
+    ray_tpu.init(resources={"CPU": 4, "memory": 4}, num_workers=2)
+    try:
+        cluster = _get_runtime().cluster
+
+        @ray_tpu.remote(num_cpus=1)
+        def work(i):
+            time.sleep(TASK_S)
+            return i
+
+        _one_round(ray_tpu, cluster, work)          # warm the pools
+        times = [_one_round(ray_tpu, cluster, work)
+                 for _ in range(ROUNDS)]
+    finally:
+        ray_tpu.shutdown()
+
+    p50 = float(np.percentile(times, 50))
+    # kill-instead-of-drain re-runs the node's in-flight tasks: with
+    # ~half the backlog on the drained node, that is the work a drain
+    # keeps instead of burning (lower bound; ignores retry scheduling)
+    naive_ms = (N_TASKS / 2) * TASK_S * 1e3
+    print(json.dumps({
+        "metric": f"p50 drain-to-empty: node running {N_TASKS} short "
+                  f"tasks ({int(TASK_S * 1e3)}ms each), zero task "
+                  "failures",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(naive_ms / p50, 2),    # x vs kill+retry
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ from .common import (Config, NodeResources, ResourceRequest, get_config)
 _API_NAMES = ("init", "shutdown", "is_initialized", "remote", "get", "put",
               "wait", "cancel", "kill", "get_actor",
               "available_resources", "cluster_resources", "nodes",
+              "drain_node",
               "timeline", "worker_stacks", "get_runtime_context",
               "list_named_actors")
 

@@ -720,7 +720,28 @@ def nodes() -> list[dict]:
     for row in range(totals.shape[0]):
         if mask[row]:
             nid = rt.crm.id_of(row)
+            draining = rt.crm.is_draining(row)
             out.append({"NodeID": nid.hex() if nid else None,
                         "Alive": True, "Row": row,
+                        "Status": "DRAINING" if draining else "ALIVE",
                         "Labels": rt.crm.labels_of(row)})
     return out
+
+
+def drain_node(node_id, reason: str = "",
+               deadline_s: float | None = None) -> dict:
+    """Gracefully retire a node: ALIVE -> DRAINING -> removed.  The
+    node stops accepting new leases/bundles immediately, running tasks
+    finish, queued work and PG bundles re-place elsewhere, sole-copy
+    objects migrate off, and the node is removed once empty or at
+    ``deadline_s`` (default ``drain_deadline_s``), whichever is first.
+    ``node_id`` is a NodeID or its hex string.  Returns the drain
+    status dict ({"state": "DRAINING", ...})."""
+    from .common.ids import NodeID
+    if isinstance(node_id, str):
+        node_id = NodeID.from_hex(node_id)
+    rt = _get_runtime()
+    if not hasattr(rt, "cluster"):      # client mode: ask the head
+        return rt.drain_node(node_id.hex(), reason, deadline_s)
+    return rt.cluster.drain_node(node_id, reason=reason,
+                                 deadline_s=deadline_s)

@@ -58,10 +58,16 @@ class StandardAutoscaler:
         self._stop = False
         self._thread: threading.Thread | None = None
         self._idle_since: dict = {}             # NodeID -> monotonic time
+        self._surplus_since: dict = {}          # NodeID -> monotonic time
+        self._migrating: set = set()            # sole-copy pulls in flight
         self._lock = threading.Lock()           # one update at a time
         # stats
         self.num_launched = 0
         self.num_terminated = 0
+        self.num_drained = 0
+        self.migrations_started = 0
+        self.migrations_completed = 0
+        self.migrations_failed = 0
         self.device_rounds = 0
         self.oracle_rounds = 0
         self.last_unmet = 0
@@ -200,16 +206,28 @@ class StandardAutoscaler:
 
     def _scale_down(self) -> list:
         """Terminate nodes idle past the timeout (never the head; never
-        below ``min_workers`` worker nodes)."""
+        below ``min_workers`` worker nodes).  With
+        ``autoscaler_drain_busy`` on, BUSY nodes whose capacity the
+        cluster no longer needs are gracefully drained instead of
+        waiting (possibly forever) for idleness."""
         cluster = self._cluster
+        cfg = get_config()
         now = time.monotonic()
         totals, avail, mask = cluster.crm.arrays()
+        drain_mask = cluster.crm.draining
         terminated = []
         rows = [(row, r) for row, r in list(cluster.raylets.items())
                 if row != cluster._head_row]
         live_workers = len(rows)
+        # nodes already DRAINING are on their way out: skip them below,
+        # but count them as leaving so this round keeps min_workers
+        leaving = sum(1 for row, _ in rows if drain_mask[row])
         requested = list(getattr(self, "_requested", ()))
         for row, raylet in rows:
+            if drain_mask[row]:
+                self._idle_since.pop(raylet.node_id, None)
+                self._surplus_since.pop(raylet.node_id, None)
+                continue
             fully_free = bool(mask[row]) and \
                 (avail[row] == totals[row]).all()
             if fully_free and requested and \
@@ -220,18 +238,21 @@ class StandardAutoscaler:
                 self._idle_since.pop(raylet.node_id, None)
                 continue
             if fully_free and raylet.is_idle():
+                self._surplus_since.pop(raylet.node_id, None)
                 sole = cluster.directory.sole_copies_on(row)
                 if sole:
                     # the node holds the only copy of live objects:
                     # terminating would destroy them (or burn lineage
                     # retries).  Migrate to the head first; the node
-                    # retires on a later round once the copies land
-                    # (reference: drain-before-terminate).
+                    # retires on a later round once a FRESH sole-copy
+                    # scan comes back empty — i.e. the copies actually
+                    # landed (reference: drain-before-terminate).
                     self._migrate_off(sole, row)
                     continue
                 t0 = self._idle_since.setdefault(raylet.node_id, now)
                 if (now - t0 >= self._idle_timeout and
-                        live_workers - len(terminated) > self._min_workers):
+                        live_workers - len(terminated) - leaving
+                        > self._min_workers):
                     cluster.events.emit(
                         "autoscaler", "idle_node_terminated", node_row=row,
                         node_id=raylet.node_id.hex(),
@@ -242,6 +263,27 @@ class StandardAutoscaler:
                     terminated.append(raylet.node_id)
             else:
                 self._idle_since.pop(raylet.node_id, None)
+                # busy-but-surplus: the cluster fits all explicit demand
+                # without this node and nothing is unmet — hand its work
+                # off gracefully instead of waiting for idleness
+                # (Aryl-style preemption-aware scale-down)
+                if (cfg.autoscaler_drain_busy and bool(mask[row])
+                        and self.last_unmet == 0
+                        and live_workers - len(terminated) - leaving
+                        > self._min_workers
+                        and self._fits_without(row, requested)):
+                    t0 = self._surplus_since.setdefault(raylet.node_id,
+                                                        now)
+                    if now - t0 >= cfg.autoscaler_drain_surplus_s:
+                        self._surplus_since.pop(raylet.node_id, None)
+                        cluster.drain_node(
+                            raylet.node_id,
+                            reason="autoscaler: busy-but-surplus "
+                                   "scale-down")
+                        self.num_drained += 1
+                        leaving += 1
+                else:
+                    self._surplus_since.pop(raylet.node_id, None)
         return terminated
 
     def _fits_without(self, row: int, requested) -> bool:
@@ -250,10 +292,11 @@ class StandardAutoscaler:
         fit (same granularity the launch packer uses)."""
         cluster = self._cluster
         _totals, avail, mask = cluster.crm.arrays()
+        drain_mask = cluster.crm.draining
         width = avail.shape[1]
         remaining = {r: avail[r].astype(np.int64).copy()
                      for r in cluster.raylets
-                     if r != row and mask[r]}
+                     if r != row and mask[r] and not drain_mask[r]}
         for req in requested:
             vec = req.dense(cluster.crm.resource_index, width)
             placed = False
@@ -267,21 +310,48 @@ class StandardAutoscaler:
         return True
 
     def _migrate_off(self, object_ids, row: int) -> None:
-        """Pull sole-copy objects to the head so the node becomes safe to
-        retire."""
+        """Pull sole-copy objects to the head so the node becomes safe
+        to retire.  Completion-tracked: every plasma kind a directory
+        entry can carry (shm, spill, AND agent-plane ``remote``)
+        migrates, callbacks record landings/failures, and in-flight
+        pulls are not re-requested.  The node only retires once a fresh
+        ``sole_copies_on`` scan comes back empty — i.e. the directory
+        saw each copy land on the head."""
+        from ..runtime.object_store import PLASMA_KINDS
         from ..runtime.pull_manager import PullPriority
         cluster = self._cluster
         head_row = cluster._head_row
         store = cluster.store
         for oid in object_ids:
+            if oid in self._migrating:
+                continue                    # pull already in flight
             kind, size = store.plasma_info(oid)
-            if kind in ("shm", "spill"):
-                cluster.pull_manager.request_pull(
-                    oid, size, head_row, PullPriority.TASK_ARG)
+            if kind not in PLASMA_KINDS:
+                continue                    # reclaimed since the scan
+            self._migrating.add(oid)
+            self.migrations_started += 1
+            if cluster.pull_manager.request_pull(
+                    oid, size, head_row, PullPriority.TASK_ARG,
+                    callback=lambda ok, o=oid:
+                    self._migration_done(o, ok)):
+                self._migration_done(oid, True)     # already satisfied
+
+    def _migration_done(self, oid, ok: bool) -> None:
+        self._migrating.discard(oid)
+        if ok:
+            self.migrations_completed += 1
+        else:
+            self.migrations_failed += 1
+            self._cluster.events.emit("autoscaler", "migration_failed",
+                                      object_id=oid.hex())
 
     def stats(self) -> dict:
         return {"num_launched": self.num_launched,
                 "num_terminated": self.num_terminated,
+                "num_drained": self.num_drained,
+                "migrations_started": self.migrations_started,
+                "migrations_completed": self.migrations_completed,
+                "migrations_failed": self.migrations_failed,
                 "device_rounds": self.device_rounds,
                 "oracle_rounds": self.oracle_rounds,
                 "last_unmet": self.last_unmet}

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
+import time
 import uuid
 
 import numpy as np
@@ -152,6 +153,9 @@ class Cluster:
                                        host=get_config().dashboard_host)
         self._head_row: int | None = None
         self._stack_waits: dict[str, tuple] = {}    # live stack dumps
+        # node drain lifecycle (ALIVE -> DRAINING -> removed/dead):
+        # NodeID -> status dict; completed drains stay for status queries
+        self._drains: dict[NodeID, dict] = {}
 
     def _reclaim_object(self, oid) -> None:
         """Refcount hit zero cluster-wide: free the object everywhere and
@@ -381,6 +385,160 @@ class Cluster:
         # changes re-trigger scheduling in both directions, like
         # add_node's wake (reference: the resource broadcast)
         self.wake_raylets()
+
+    # -- graceful drain (ALIVE -> DRAINING -> removed/dead) ------------------
+    def drain_node(self, node_id: NodeID, reason: str = "",
+                   deadline_s: float | None = None) -> dict:
+        """Gracefully retire a node (preemption notice, scale-down).
+
+        The node is masked out of every placement view immediately (no
+        new leases or PG bundles land on it), its queued/pipelined work
+        re-enters global scheduling, its PG bundles re-place atomically
+        elsewhere, and sole-copy plasma objects migrate to the head.
+        Running tasks finish normally.  A monitor thread removes the
+        node once it is empty — or at ``deadline_s``, whichever comes
+        first; a node that DIES mid-drain converges through the health
+        manager's dead path.  Returns the drain status dict immediately
+        (poll ``drain_status`` or join via ``wait_for_drain``)."""
+        if deadline_s is None:
+            deadline_s = get_config().drain_deadline_s
+        with self._lock:
+            row = self.crm.row_of(node_id)
+            if row is None or row == self._head_row:
+                raise ValueError("cannot drain head node or unknown node")
+            st = self._drains.get(node_id)
+            if st is not None and st["state"] == "DRAINING":
+                return self._drain_view(st)     # idempotent
+            self.crm.set_draining(node_id, True)
+            st = {"node_id": node_id.hex(), "row": row, "reason": reason,
+                  "deadline_s": float(deadline_s), "state": "DRAINING",
+                  "outcome": None, "started": time.monotonic(),
+                  "migrated_objects": 0, "displaced_groups": 0}
+            self._drains[node_id] = st
+            raylet = self.raylets.get(row)
+        self.events.emit("node", "node_draining", node_row=row,
+                         node_id=node_id.hex(), reason=reason,
+                         deadline_s=deadline_s)
+        # drain notice BEFORE displacing work: subscribers (the elastic
+        # trainer) get the chance to checkpoint-and-resize proactively
+        self.pubsub.publish("node", {"event": "draining", "row": row,
+                                     "node_id": node_id.hex(),
+                                     "reason": reason,
+                                     "deadline_s": deadline_s})
+        st["displaced_groups"] = self.pg_manager.on_node_draining(row)
+        if raylet is not None:
+            raylet.start_graceful_drain()
+            # remote node: tell its agent to stop autonomous local
+            # dispatch and hand queued leases back (best-effort — a
+            # dead agent converges via the health manager anyway)
+            sp = getattr(raylet.pool, "_spawner", None)
+            if sp is not None and hasattr(sp, "drain_remote"):
+                try:
+                    sp.drain_remote()
+                except Exception:   # noqa: BLE001
+                    pass
+        self.wake_raylets()         # requeued backlog needs a round
+        thread = threading.Thread(target=self._drain_monitor,
+                                  args=(node_id, st), daemon=True,
+                                  name=f"drain-{row}")
+        st["_thread"] = thread
+        thread.start()
+        return self._drain_view(st)
+
+    def _drain_monitor(self, node_id: NodeID, st: dict) -> None:
+        poll = max(get_config().drain_poll_ms, 1) / 1000.0
+        deadline = st["started"] + st["deadline_s"]
+        row = st["row"]
+        from .runtime.pull_manager import PullPriority
+        inflight: dict = {}         # oid -> pull in flight
+        mlock = threading.Lock()
+
+        def _migrated(oid):
+            def cb(ok: bool) -> None:
+                with mlock:
+                    inflight.pop(oid, None)
+                    if ok:
+                        st["migrated_objects"] += 1
+            return cb
+
+        while True:
+            with self._lock:
+                gone = self.crm.row_of(node_id) is None
+                raylet = self.raylets.get(row)
+            if gone:        # died mid-drain: health manager removed it
+                self._finish_drain(node_id, st, "dead")
+                return
+            # migrate sole copies to the head — re-scanned every tick
+            # because RUNNING tasks keep sealing new objects mid-drain
+            sole = self.directory.sole_copies_on(row)
+            for oid in sole:
+                with mlock:
+                    if oid in inflight:
+                        continue
+                    inflight[oid] = True
+                _kind, size = self.store.plasma_info(oid)
+                if self.pull_manager.request_pull(
+                        oid, size, self._head_row, PullPriority.TASK_ARG,
+                        callback=_migrated(oid)):
+                    with mlock:     # already at the head
+                        inflight.pop(oid, None)
+            with mlock:
+                migrating = bool(inflight)
+            if raylet is None or (raylet.drain_empty() and not migrating
+                                  and not sole):
+                outcome = "drained"
+            elif time.monotonic() >= deadline:
+                outcome = "deadline"    # grace expired: forced removal
+            else:
+                time.sleep(poll)
+                continue
+            try:
+                self.remove_node(node_id)
+            except (ValueError, KeyError):
+                outcome = "dead"        # node death raced the removal
+            self._finish_drain(node_id, st, outcome)
+            return
+
+    def _finish_drain(self, node_id: NodeID, st: dict,
+                      outcome: str) -> None:
+        st["outcome"] = outcome
+        st["state"] = "DEAD" if outcome == "dead" else "REMOVED"
+        st["elapsed_s"] = round(time.monotonic() - st["started"], 3)
+        self.events.emit("node", "node_drain_finished",
+                         node_row=st["row"], node_id=st["node_id"],
+                         outcome=outcome, elapsed_s=st["elapsed_s"],
+                         migrated_objects=st["migrated_objects"],
+                         displaced_groups=st["displaced_groups"])
+
+    @staticmethod
+    def _drain_view(st: dict) -> dict:
+        return {k: v for k, v in st.items() if not k.startswith("_")}
+
+    def drain_status(self, node_id: NodeID | None = None):
+        """Status dict for one node's drain (None if never drained), or
+        every drain this cluster has seen."""
+        with self._lock:
+            if node_id is not None:
+                st = self._drains.get(node_id)
+                return None if st is None else self._drain_view(st)
+            return [self._drain_view(st) for st in self._drains.values()]
+
+    def is_draining(self, node_id: NodeID) -> bool:
+        with self._lock:
+            st = self._drains.get(node_id)
+            return st is not None and st["state"] == "DRAINING"
+
+    def wait_for_drain(self, node_id: NodeID,
+                       timeout: float | None = None) -> dict | None:
+        """Block until a started drain finishes; returns its status."""
+        with self._lock:
+            st = self._drains.get(node_id)
+        if st is None:
+            return None
+        thread = st.get("_thread")
+        if thread is not None:
+            thread.join(timeout)
+        return self._drain_view(st)
 
     def wake_raylets(self, exclude=None) -> None:
         """Re-trigger every raylet's scheduling loop (cluster

@@ -181,6 +181,23 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         int, 4096,
         "Minimum total pending-demand count routed to the device binpack "
         "kernel; smaller rounds use the bit-identical CPU oracle."),
+    # -- graceful node drain ------------------------------------------------
+    "drain_deadline_s": (
+        float, 30.0,
+        "Default grace period for Cluster.drain_node: a DRAINING node "
+        "still busy past this is force-removed (preemption-notice "
+        "semantics)."),
+    "drain_poll_ms": (
+        int, 50,
+        "Drain monitor poll period (empty-check + sole-copy rescan)."),
+    "autoscaler_drain_busy": (
+        bool, False,
+        "Let _scale_down DRAIN busy-but-surplus nodes (graceful "
+        "handoff) instead of only terminating fully-idle ones."),
+    "autoscaler_drain_surplus_s": (
+        float, 10.0,
+        "How long a busy node must stay surplus (cluster fits without "
+        "it, no pending demand) before the autoscaler drains it."),
     # -- device -------------------------------------------------------------
     # (score scale and max node count are compile-time contract constants in
     # scheduling/contract.py — SCALE, MAX_NODES — not runtime knobs: the key

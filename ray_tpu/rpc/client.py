@@ -28,15 +28,24 @@ class RemoteRpcError(RuntimeError):
         self.remote_tb = remote_tb
 
 
+# Sentinel distinguishing "caller said nothing" (inherit the client's
+# constructor timeout) from an EXPLICIT ``timeout=None`` (block forever
+# — the opt-out long gets/waits use deliberately).
+_UNSET = object()
+
+
 class RpcClient:
     def __init__(self, address: str, timeout: float = 10.0,
                  on_close=None):
         """``on_close`` fires once, from the reader thread, when the
         connection drops (peer gone or local close) — the hook node
-        agents/hubs use for disconnect-driven cleanup."""
+        agents/hubs use for disconnect-driven cleanup.  ``timeout`` is
+        both the connect deadline and the DEFAULT per-call deadline for
+        ``call`` sites that don't pass their own."""
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
+        self._default_timeout = timeout
         self._sock.settimeout(None)     # calls manage their own deadlines
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
@@ -48,8 +57,13 @@ class RpcClient:
                                         daemon=True, name="rpc-reader")
         self._reader.start()
 
-    def call(self, method: str, *args, timeout: float | None = None,
-             **kwargs):
+    def call(self, method: str, *args, timeout=_UNSET, **kwargs):
+        # Omitted timeout falls back to the constructor default: a hung
+        # or wedged peer fails the call instead of parking the caller
+        # forever.  Pass ``timeout=None`` EXPLICITLY to wait unbounded
+        # (long gets/waits that manage their own deadline).
+        if timeout is _UNSET:
+            timeout = self._default_timeout
         req_id = next(self._ids)
         slot = [threading.Event(), None, None]
         self._pending[req_id] = slot

@@ -141,6 +141,7 @@ class HeadNode:
             "stream_close": self._stream_close,
             "status": self._status,
             "nodes": self._nodes,
+            "drain_node": self._drain_node,
             "available_resources": self._available_resources,
             "cluster_resources": self._cluster_resources,
             "timeline": self._timeline,
@@ -346,11 +347,19 @@ class HeadNode:
             "cluster_resources": api.cluster_resources(),
             "store": cluster.store.stats(),
             "jobs": self.jobs.list(),
+            "drains": cluster.drain_status(),
         }
 
     def _nodes(self) -> list[dict]:
         from .. import api
         return api.nodes()
+
+    def _drain_node(self, node_id_hex: str, reason: str = "",
+                    deadline_s: float | None = None) -> dict:
+        from ..common.ids import NodeID
+        return self._rt.cluster.drain_node(
+            NodeID.from_hex(node_id_hex), reason=reason,
+            deadline_s=deadline_s)
 
     def _available_resources(self) -> dict:
         from .. import api

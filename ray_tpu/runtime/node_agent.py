@@ -168,6 +168,7 @@ class NodeAgent:
         self._pin_lock = threading.Lock()
         # -- autonomous local dispatch state --------------------------------
         self._fast_enabled = False      # head policy (register reply)
+        self._draining = False          # node DRAINING: no new local leases
         self._policy_pushed = False     # an a_policy push wins over a
         #                                 concurrently-computed register
         #                                 reply (job env landing mid-
@@ -215,6 +216,7 @@ class NodeAgent:
             "a_ping": lambda: "ok",
             "a_policy": self._a_policy,
             "a_cancel": self._a_cancel,
+            "a_drain": self._a_drain,
         }
         handlers.update(self.plane.handlers())
         self.server = RpcServer(handlers, host=host, port=port).start()
@@ -236,7 +238,7 @@ class NodeAgent:
                     reply = self._head.call(
                         "agent_register", self.agent_id,
                         self.server.address, resources, num_workers,
-                        labels, True)
+                        labels, True, timeout=120.0)
                     self._apply_register_reply(reply, resources)
                     break
                 except Exception:
@@ -282,6 +284,20 @@ class NodeAgent:
         self._policy_pushed = True
         self._fast_enabled = bool(policy.get("fast_path", False))
         return True
+
+    def _a_drain(self) -> int:
+        """Node is DRAINING: stop leasing locally and hand every
+        accepted-but-undispatched task back to the head for global
+        placement ("requeue" — never ran, no retry consumed).  Tasks a
+        worker is already RUNNING finish normally and report through
+        the usual done-sync.  Returns how many were handed back."""
+        self._draining = True
+        with self._view_lock:
+            handed = list(self._local_queue)
+            self._local_queue.clear()
+        for e in handed:
+            self._finish_local(e, None, None, None, "requeue")
+        return len(handed)
 
     # -- head failover -------------------------------------------------------
     def _on_head_lost(self) -> None:
@@ -334,7 +350,8 @@ class NodeAgent:
                     reply = self._head.call(
                         "agent_register", self.agent_id,
                         self.server.address, self._resources,
-                        self._num_workers, self._labels, True)
+                        self._num_workers, self._labels, True,
+                        timeout=120.0)
                     self._apply_register_reply(reply, self._resources)
                     return      # rejoined
                 except Exception:   # noqa: BLE001 — head still down
@@ -351,6 +368,7 @@ class NodeAgent:
         their done-sync — drop them (the head's drain fails/retries
         registered ones, exactly like node death)."""
         self._fast_enabled = False
+        self._draining = False      # a fresh head re-decides the drain
         self._policy_pushed = False     # fresh head: fresh policy
         with self._sync_lock:
             self._sync_batch.clear()
@@ -719,7 +737,7 @@ class NodeAgent:
         free.  Returns True when the task was taken (the submit frame
         must then be swallowed); False relays it to the head for
         global placement."""
-        if not self._fast_enabled:
+        if not self._fast_enabled or self._draining:
             return False
         sub = self._w_state.get(submitter)
         if sub is None or sub["env"] or sub["dedicated"]:
@@ -1109,7 +1127,10 @@ class NodeAgent:
             if msg is None:
                 continue        # fully handled locally (autonomy path)
             try:
-                self._head.call("agent_frame", self.agent_id, index, msg)
+                # explicit no-deadline: a large result frame draining
+                # slowly is not a dead head; loss raises via on_close
+                self._head.call("agent_frame", self.agent_id, index,
+                                msg, timeout=None)
             except Exception:   # noqa: BLE001 — head gone: nothing to
                 return          # relay to; the on_close hook is already
                 #                 ending the agent
@@ -1233,7 +1254,8 @@ class AgentSpawner:
             # no deadline: a slow worker draining a large frame is NOT a
             # dead worker (a timeout here would dead-mark it and run the
             # task twice); a truly lost link raises RpcConnectionError
-            ok = self._client.call("a_send", index, msg)
+            ok = self._client.call("a_send", index, msg,
+                                   timeout=None)
         except Exception as e:
             raise BrokenPipeError(f"agent link lost: {e}") from e
         if not ok:
@@ -1254,6 +1276,15 @@ class AgentSpawner:
         try:
             return self._client.call("a_cancel", tid_bin, force,
                                      timeout=10.0)
+        except Exception:       # noqa: BLE001
+            return None
+
+    def drain_remote(self) -> int | None:
+        """Relay a node drain to the agent: it stops autonomous local
+        dispatch and hands queued leases back.  Best-effort — a dead
+        agent converges through the health manager's dead path."""
+        try:
+            return self._client.call("a_drain", timeout=10.0)
         except Exception:       # noqa: BLE001
             return None
 

@@ -275,6 +275,35 @@ class PlacementGroupManager:
             if self._pending:
                 self._ensure_ticker()
 
+    def on_node_draining(self, row: int) -> int:
+        """A node holding bundles is DRAINING: release reservations on
+        EVERY row — including the draining one, which is still alive so
+        its base resources really do come back — and re-pend the group.
+        Re-placement runs against the CRM snapshot, whose drain mask
+        excludes the row, so the whole group (STRICT_PACK included)
+        reschedules atomically elsewhere.  Returns how many groups were
+        displaced."""
+        displaced = 0
+        with self._lock:
+            for rec in self._groups.values():
+                if rec.state != "CREATED" or row not in rec.rows:
+                    continue
+                pg_hex = rec.pg_id.hex()
+                for b, r in enumerate(rec.rows):
+                    req = ResourceRequest(rec.bundles[b])
+                    self._crm.remove_shaped_resources(
+                        r, _bundle_shaped_cu(req, pg_hex, b))
+                    self._crm.add_back(r, req)
+                rec.rows = []
+                rec.state = "PENDING"
+                self._store.delete([rec.ready_oid])
+                if rec.pg_id not in self._pending:
+                    self._pending.append(rec.pg_id)
+                displaced += 1
+            if self._pending:
+                self._ensure_ticker()
+        return displaced
+
     # -- removal ------------------------------------------------------------
     def remove(self, pg_id: PlacementGroupID) -> None:
         with self._lock:

@@ -140,6 +140,10 @@ class Raylet:
         self._assigned_total = 0
         self._avoid_local: set[TaskID] = set()  # lease-spilled: skip here
         self._stopped = False
+        # DRAINING: no new leases commit here, running tasks finish;
+        # the pool and event loop stay alive (unlike _stopped) so the
+        # node keeps scheduling its backlog onto OTHER rows
+        self._draining = False
         self._dirty = False     # wake flag: new task / capacity / worker
         self.actor_manager = None   # attached by the runtime/cluster
         # agent-autonomous dispatch bookkeeping (plane agents only):
@@ -261,6 +265,10 @@ class Raylet:
         over-assign this node.  Plasma args not yet local are pulled at
         task-arg priority; dispatch waits for the copies (reference:
         DependencyManager asks the PullManager for task args)."""
+        if self._draining:
+            # route_local raced the drain: back to global scheduling
+            self._enqueue(task_id)
+            return
         rec = self.task_manager.get(task_id)
         pulls = []
         if rec is not None:
@@ -283,6 +291,19 @@ class Raylet:
             self._local_since[task_id] = time.monotonic()
             self._dirty = True
             self._cv.notify_all()
+        if self._draining:
+            # a placement round snapshotted before the drain mask landed
+            # routed here: bounce straight back to global scheduling so
+            # the guarantee "zero new leases after drain_node" holds
+            with self._cv:
+                if task_id in self._local_queue:
+                    self._local_queue.remove(task_id)
+                    self._local_since.pop(task_id, None)
+                    self._pull_pending.pop(task_id, None)
+                    if rec is not None:
+                        self._planned_add(rec.spec.resources, -1)
+                    self._queue.append(task_id)
+                    self._cv.notify_all()
         if pulls:
             from .pull_manager import PullPriority
             for oid, size in pulls:
@@ -885,7 +906,7 @@ class Raylet:
         env_missed: set = set()         # env keys already counted a miss
         kicked = False                  # autoscaler kicked this pass
         with self._cv:
-            if not self._local_queue:
+            if self._draining or not self._local_queue:
                 return
             # oldest class first (head-entry enqueue time): bucket order
             # must not starve a lone task of a late class behind an
@@ -1361,7 +1382,7 @@ class Raylet:
                 or getattr(worker, "dedicated", False):
             return False
         with self._cv:
-            if self._stopped or not self._local_queue:
+            if self._stopped or self._draining or not self._local_queue:
                 return False
             # oldest class head (same order _drain_local visits)
             pick, oldest = None, float("inf")
@@ -1401,6 +1422,8 @@ class Raylet:
             spill = list(worker.assigned)
             worker.assigned.clear()
             self._assigned_total -= len(spill)
+            if self._draining:
+                to_global = True    # local requeue would lease here again
         for task_id, _t in spill:
             rec = self.task_manager.get(task_id)
             if rec is None or rec.done:
@@ -2116,6 +2139,58 @@ class Raylet:
                 return True
             return False        # running + non-force: like local path
         return False
+
+    def start_graceful_drain(self) -> None:
+        """ALIVE -> DRAINING: stop committing new leases here while
+        running tasks finish.  Unlike ``drain_for_removal`` the pool and
+        event loop stay up: queued and pipelined-but-unsent work
+        re-enters GLOBAL scheduling, and because the CRM drain mask
+        makes this row infeasible to every policy, it lands elsewhere.
+        Idempotent."""
+        with self._cv:
+            if self._draining:
+                return
+            self._draining = True
+        # pipelined-but-unsent leases come back and re-place globally
+        with self.pool._lock:
+            workers = list(self.pool._workers)
+        for w in workers:
+            self._recall_assigned(w, to_global=True)
+        with self._cv:
+            requeue = list(self._local_queue)
+            self._local_queue.clear()
+            for task_id in requeue:
+                self._local_since.pop(task_id, None)
+                self._env_miss_since.pop(task_id, None)
+                # in-flight arg pulls: the entry goes now, so a late
+                # _pull_done finds nothing and no-ops
+                self._pull_pending.pop(task_id, None)
+                rec = self.task_manager.get(task_id)
+                if rec is not None:
+                    self._planned_add(rec.spec.resources, -1)
+                self._queue.append(task_id)
+            self._dirty = True
+            self._cv.notify_all()
+
+    def is_draining(self) -> bool:
+        return self._draining
+
+    def drain_empty(self) -> bool:
+        """Nothing left that holds this node's resources or would die
+        with it: no backlog awaiting (re-)placement, no leases, no
+        running tasks, no agent-leased work, no live actor workers.
+        Dep-WAITING tasks are deliberately excluded — they hold no
+        lease, and forced removal reroutes their readiness callbacks
+        through the fallback raylet."""
+        with self._cv:
+            busy = (self._queue or self._local_queue or self._running
+                    or self._pull_pending or self.agent_inflight
+                    or self._assigned_total)
+        if busy:
+            return False
+        with self.pool._lock:
+            return not any(w.dedicated and not w.dead
+                           for w in self.pool._workers)
 
     def drain_for_removal(self, fallback: "Raylet") -> None:
         """Node death: fail/retry running tasks, reroute queued ones,

@@ -44,6 +44,10 @@ class ClusterResourceManager:
         self.totals = np.zeros((self._capacity, self._r_slots), dtype=np.int32)
         self.avail = np.zeros_like(self.totals)
         self.node_mask = np.zeros(self._capacity, dtype=bool)
+        # DRAINING rows stay registered (running tasks keep their debits,
+        # heartbeats still sync) but every placement view masks them out,
+        # so no new work lands there while the drain completes
+        self.draining = np.zeros(self._capacity, dtype=bool)
         self._row_of: dict[NodeID, int] = {}
         self._id_of: dict[int, NodeID] = {}
         self._labels: dict[int, dict[str, str]] = {}
@@ -61,6 +65,7 @@ class ClusterResourceManager:
             for name, cu in resources.available_cu.items():
                 self.avail[row, self._col(name)] = cu
             self.node_mask[row] = True
+            self.draining[row] = False
             self._row_of[node_id] = row
             self._id_of[row] = node_id
             self._labels[row] = dict(resources.labels)
@@ -77,7 +82,31 @@ class ClusterResourceManager:
             self.totals[row] = 0
             self.avail[row] = 0
             self.node_mask[row] = False
+            self.draining[row] = False
             self.version += 1
+
+    # -- drain lifecycle (ALIVE -> DRAINING -> removed) ---------------------
+    def set_draining(self, node_id: NodeID, flag: bool = True) -> int | None:
+        """Mark/unmark a node DRAINING.  Returns its row, or None if the
+        node is unknown (already removed — drain raced with death)."""
+        with self._lock:
+            row = self._row_of.get(node_id)
+            if row is None:
+                return None
+            if bool(self.draining[row]) != flag:
+                self.draining[row] = flag
+                self.version += 1
+            return row
+
+    def is_draining(self, row: int) -> bool:
+        with self._lock:
+            return bool(self.draining[row]) if 0 <= row < self._capacity \
+                else False
+
+    def draining_rows(self) -> list[int]:
+        with self._lock:
+            return [int(r) for r in
+                    np.flatnonzero(self.node_mask & self.draining)]
 
     def _alloc_row(self) -> int:
         free = np.flatnonzero(~self.node_mask)
@@ -99,6 +128,9 @@ class ClusterResourceManager:
         mask = np.zeros(cap, dtype=bool)
         mask[:self._capacity] = self.node_mask
         self.node_mask = mask
+        drain = np.zeros(cap, dtype=bool)
+        drain[:self._capacity] = self.draining
+        self.draining = drain
         self._capacity = cap
 
     def _col(self, name: str) -> int:
@@ -209,8 +241,10 @@ class ClusterResourceManager:
         discipline: policies never see live mutable state — SURVEY §4
         'every scheduling decision is testable without real distribution')."""
         with self._lock:
+            # DRAINING rows are infeasible for every placement consumer
+            # (raylet rounds, pg bundles, autoscaler demand, trainer fit)
             return ClusterState(self.totals.copy(), self.avail.copy(),
-                                self.node_mask.copy())
+                                self.node_mask & ~self.draining)
 
     def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         with self._lock:
@@ -231,7 +265,7 @@ class ClusterResourceManager:
     def label_mask(self, label_selector: dict[str, str]) -> np.ndarray:
         """(capacity,) bool mask of nodes matching all label k=v pairs."""
         with self._lock:
-            mask = self.node_mask.copy()
+            mask = self.node_mask & ~self.draining
             for row in range(self._capacity):
                 if not mask[row]:
                     continue

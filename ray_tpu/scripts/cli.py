@@ -189,8 +189,14 @@ def cmd_status(args) -> int:
     print(f"session: {st['session_dir']}")
     print(f"nodes ({len(st['nodes'])}):")
     for n in st["nodes"]:
+        status = n.get("Status", "ALIVE")
         print(f"  {n['NodeID'][:16]}…  row={n['Row']} "
-              f"labels={n['Labels']}")
+              f"{status:<8} labels={n['Labels']}")
+    for d in st.get("drains") or []:
+        if d.get("state") == "DRAINING":
+            print(f"  draining: {d['node_id'][:16]}… "
+                  f"reason={d.get('reason') or '-'} "
+                  f"deadline_s={d.get('deadline_s')}")
     print("resources:")
     total, avail = st["cluster_resources"], st["available_resources"]
     for name in sorted(total):
@@ -199,6 +205,20 @@ def cmd_status(args) -> int:
         print(f"jobs ({len(st['jobs'])}):")
         for j in st["jobs"]:
             print(f"  {j['job_id']}  {j['status']:<10} {j['entrypoint']}")
+    return 0
+
+
+def cmd_drain(args) -> int:
+    """``ray_tpu drain <node_id>`` — preemption-notice drain
+    (reference: ``ray drain-node`` / the DrainNode RPC)."""
+    client = _client(args.address)
+    try:
+        st = client.call("drain_node", args.node_id, args.reason,
+                         args.deadline, timeout=30.0)
+    finally:
+        client.close()
+    print(f"{st['node_id'][:16]}…  {st['state']} "
+          f"deadline_s={st['deadline_s']} reason={st['reason']}")
     return 0
 
 
@@ -415,6 +435,18 @@ def build_parser() -> argparse.ArgumentParser:
     pq = sub.add_parser("status", help="cluster status")
     pq.add_argument("--address", default=None)
     pq.set_defaults(fn=cmd_status)
+
+    pd = sub.add_parser(
+        "drain", help="gracefully retire a node (ALIVE -> DRAINING "
+                      "-> removed); running tasks finish, queued work "
+                      "and bundles re-place elsewhere")
+    pd.add_argument("node_id", help="node id (hex, from `status`)")
+    pd.add_argument("--reason", default="cli drain")
+    pd.add_argument("--deadline", type=float, default=None,
+                    help="grace seconds before force-removal "
+                         "(default: drain_deadline_s config)")
+    pd.add_argument("--address", default=None)
+    pd.set_defaults(fn=cmd_drain)
 
     pl = sub.add_parser("list", help="list live cluster state")
     pl.add_argument("kind", choices=["tasks", "actors", "objects",

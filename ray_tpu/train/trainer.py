@@ -239,12 +239,43 @@ class JaxTrainer:
         run_id = os.urandom(4).hex()
         persist_key = f"ckpt-{run_id}"
         max_failures = self._failure.max_failures
-        attempt = 0
+        attempt = 0         # total restarts (drives elastic resize)
+        failures = 0        # REAL failures only (drives max_failures)
         pg = None
         pg_size = 0         # bundle count of the LIVE pg (pg is None ok)
         shards: list = []
         shard_world = -1    # world size the shards were cut for
         log = logging.getLogger("ray_tpu.train")
+        # proactive drain handling (driver mode): a drain notice for a
+        # node hosting our bundles kills the gang NOW, so the blocked
+        # gang get raises and the next attempt checkpoints-and-resizes
+        # away from the draining node — a planned handoff, so it does
+        # NOT burn the failure budget.  The resume point is the latest
+        # checkpoint rank 0 persisted through report().
+        from ray_tpu.api import _get_runtime
+        cluster = getattr(_get_runtime(), "cluster", None)
+        drain_hit = threading.Event()
+        self._live_actors: list = []
+        live_pg: dict = {"pg": None}
+        sub = None
+        if cluster is not None:
+            def _on_node_event(msg, _c=cluster):
+                if not isinstance(msg, dict) or \
+                        msg.get("event") != "draining":
+                    return
+                pg_now = live_pg["pg"]
+                if pg_now is None:
+                    return
+                rec = _c.pg_manager.get(pg_now.id)
+                if rec is None or msg.get("row") not in rec.rows:
+                    return
+                drain_hit.set()
+                for a in list(self._live_actors):
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:   # noqa: BLE001 — already dead
+                        pass
+            sub = cluster.pubsub.subscribe("node", _on_node_event)
         try:
             while True:
                 world = n_target
@@ -300,7 +331,9 @@ class JaxTrainer:
                         pg = placement_group([dict(res)] * world,
                                              strategy="PACK")
                         pg_size = world
+                        live_pg["pg"] = pg
                         ray_tpu.get(pg.ready(), timeout=timeout)
+                    live_pg["pg"] = pg
                     if shard_world != world:
                         shards = [None] * world
                         if train_ds is not None:
@@ -313,16 +346,40 @@ class JaxTrainer:
                         persist_key, timeout)
                     break
                 except Exception as e:  # noqa: BLE001 — worker/gang death
-                    if 0 <= max_failures <= attempt:
+                    if drain_hit.is_set():
+                        # planned node handoff, not a failure: resume
+                        # from the checkpoint and resize off the
+                        # draining node (its row is already masked).
+                        # Drop the pg — the drain notice arrives BEFORE
+                        # the group is displaced, so reusing it could
+                        # land the new gang back on the doomed node; a
+                        # fresh group places against the masked row
+                        drain_hit.clear()
+                        live_pg["pg"] = None
+                        if pg is not None:
+                            remove_placement_group(pg)
+                            pg = None
+                            pg_size = 0
+                        attempt += 1
+                        log.warning(
+                            "train gang interrupted by node drain "
+                            "(restart %d); checkpointing and resizing "
+                            "away from the draining node", attempt)
+                        continue
+                    if 0 <= max_failures <= failures:
                         raise
                     attempt += 1
+                    failures += 1
                     # gang restart (reference FailureConfig): the next
                     # attempt resumes from the persisted checkpoint
-                    logging.getLogger("ray_tpu.train").warning(
+                    log.warning(
                         "train gang attempt %d failed (%s: %s); "
                         "restarting from the persisted checkpoint",
                         attempt, type(e).__name__, e)
         finally:
+            if sub is not None:
+                sub.unsubscribe()
+            self._live_actors = []
             try:
                 _internal_kv_del(persist_key, namespace="train")
             except Exception:   # noqa: BLE001 — a degraded KV must not
@@ -378,12 +435,17 @@ class JaxTrainer:
                 placement_group=pg,
                 placement_group_bundle_index=i).remote()
                 for i in range(n)]
+            # visible to the drain-notice subscriber: a draining node
+            # hosting this gang kills the actors so the get below
+            # raises instead of blocking out the whole drain deadline
+            self._live_actors = actors
             return ray_tpu.get(
                 [a.run.remote(fn_bytes, self._config, i, n, group,
                               shards[i], ckpt_state, persist_key)
                  for i, a in enumerate(actors)],
                 timeout=timeout)
         finally:
+            self._live_actors = []
             # kill in the FINALLY: a failed/timed-out gang must not
             # leak N actors (and their half-joined collective group)
             for a in actors:

@@ -152,9 +152,10 @@ class ClientRuntime:
         # bounded server-side waits so one stream doesn't pin an RPC
         # worker thread forever; loop client-side for timeout=None
         while True:
+            server_wait = 30.0 if timeout is None else timeout
             reply = self._call(
-                "stream_wait", task_id.binary(), index,
-                30.0 if timeout is None else timeout)
+                "stream_wait", task_id.binary(), index, server_wait,
+                timeout=server_wait + 30.0)
             sealed, done, err_bytes = reply[0], reply[1], reply[2]
             known = reply[3] if len(reply) > 3 else True
             err = deserialize(err_bytes) if err_bytes else None
@@ -200,6 +201,11 @@ class ClientRuntime:
 
     def nodes(self) -> list[dict]:
         return self._call("nodes")
+
+    def drain_node(self, node_id_hex: str, reason: str = "",
+                   deadline_s: float | None = None) -> dict:
+        return self._call("drain_node", node_id_hex, reason,
+                          deadline_s, timeout=30.0)
 
     def available_resources(self) -> dict:
         return self._call("available_resources")

@@ -41,7 +41,8 @@ def _apply_filters(rows: list[dict],
 
 def list_nodes(filters: list[tuple] | None = None) -> list[dict]:
     from .. import api
-    rows = [{"node_id": n["NodeID"], "state": "ALIVE",
+    rows = [{"node_id": n["NodeID"],
+             "state": n.get("Status", "ALIVE"),
              "row": n["Row"], "labels": n["Labels"]}
             for n in api.nodes()]
     return _apply_filters(rows, filters)

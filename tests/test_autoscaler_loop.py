@@ -146,6 +146,35 @@ class TestScaleDown:
         # retires down to the floor, not below
         assert len(c.raylets) >= 2      # head + 1 worker
 
+    def test_busy_surplus_node_drains_gracefully(self, small_cluster):
+        """autoscaler_drain_busy: a node still RUNNING work that the
+        cluster no longer needs is drained (graceful handoff) instead
+        of waiting for idleness — its task finishes, then it retires."""
+        Config.reset({"autoscaler_drain_busy": True,
+                      "autoscaler_drain_surplus_s": 0.2})
+        c = small_cluster
+        asc = c.start_autoscaler(TYPES, idle_timeout_s=3600.0,
+                                 interval_ms=60_000)
+
+        @ray_tpu.remote(resources={"CPU": 3})
+        def hold(i):        # only a cpu4 node fits (head has CPU:2)
+            time.sleep(2.0)
+            return i * 11
+
+        ref = hold.remote(3)
+        assert _wait_until(lambda: asc.num_launched >= 1, timeout=30)
+        time.sleep(0.5)     # the task is running; demand is met
+        asc.update()        # starts the surplus clock
+        time.sleep(0.3)
+        asc.update()        # past surplus_s: the busy node drains
+        assert _wait_until(lambda: asc.stats()["num_drained"] >= 1,
+                           timeout=30)
+        # graceful: the in-flight task completes, THEN the node retires
+        assert ray_tpu.get(ref, timeout=60) == 33
+        assert _wait_until(
+            lambda: all(not c.crm.draining[row] for row in c.raylets),
+            timeout=30)
+
 
 class TestDeviceRouting:
     def test_large_round_uses_device_kernel(self):

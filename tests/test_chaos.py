@@ -56,6 +56,7 @@ def _kill_busy_worker(rt, deadline=10.0) -> int:
     raise AssertionError("no busy worker appeared")
 
 
+@pytest.mark.chaos
 class TestWorkerKills:
     def test_sigkill_midtask_retries_and_completes(self, driver):
         @ray_tpu.remote(max_retries=2)
@@ -120,6 +121,7 @@ class TestWorkerKills:
         assert sorted(ray_tpu.get(refs, timeout=180)) == list(range(40))
 
 
+@pytest.mark.chaos
 class TestActorKills:
     def test_actor_sigkill_restarts_and_serves(self, driver):
         @ray_tpu.remote(max_restarts=2)
@@ -164,6 +166,7 @@ class TestActorKills:
             ray_tpu.get(f.pid.remote(), timeout=60)
 
 
+@pytest.mark.chaos
 class TestAgentChaos:
     def _spawn_agent(self, address, resources):
         proc = subprocess.Popen(
@@ -223,6 +226,7 @@ class TestAgentChaos:
             head.stop()
 
 
+@pytest.mark.chaos
 class TestPlacementChaos:
     def test_pg_prepare_race_rolls_back_and_retries(self, driver):
         """A task racing the 2-phase prepare steals the resources: the
@@ -262,6 +266,7 @@ class TestPlacementChaos:
         cluster.remove_node(node2)
 
 
+@pytest.mark.chaos
 class TestSpillStorm:
     def test_spill_storm_during_load(self):
         """A tiny arena forces continuous spill/restore while tasks
@@ -328,6 +333,7 @@ class TestHeadRestore:
             ray_tpu.shutdown()
 
 
+@pytest.mark.chaos
 class TestAutonomyChaos:
     """Agent death while AUTONOMOUS dispatch is mid-flight: callers
     must fail or retry — never hang on tasks only the dead agent knew

@@ -273,7 +273,7 @@ def _build_cpp_binary() -> str:
         return out
     os.makedirs(os.path.dirname(out), exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-Wall", "-Wextra", "-Werror",
-           "-o", out, os.path.join(CPP, "test_frontend.cc")]
+           "-pthread", "-o", out, os.path.join(CPP, "test_frontend.cc")]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     assert proc.returncode == 0, f"cpp build failed:\n{proc.stderr}"
     return out
