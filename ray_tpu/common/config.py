@@ -101,13 +101,35 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Minimum activation batch routed to the device pull-source "
         "kernel; smaller batches use the bit-identical numpy oracle."),
     "object_transfer_chunk_mb": (
-        int, 4,
+        int, 8,
         "Chunk size for wire-level arena-to-arena object transfer "
-        "between node planes (reference ObjectBufferPool chunking)."),
+        "between node planes (reference ObjectBufferPool chunking).  "
+        "8 MB amortizes per-chunk request/dispatch overhead on the "
+        "raw data channel while keeping stripe reassignment granular."),
     "object_transfer_threads": (
         int, 4,
         "Concurrent transfer executors in the pull manager; activation "
         "stays quota-bounded (pull_manager_max_inflight_mb)."),
+    "object_transfer_window": (
+        int, 8,
+        "Chunk requests kept in flight per stripe source (windowed "
+        "pipelining over the RPC demux).  Effective window is capped "
+        "at pull_manager_max_inflight_mb / object_transfer_chunk_mb so "
+        "the pull quota still bounds receive-side memory; 1 with a "
+        "single source restores the lockstep request-reply loop."),
+    "object_transfer_stripe_min_mb": (
+        int, 16,
+        "Minimum object size for multi-source striping: when the "
+        "directory holds >=2 replicas of an object at least this "
+        "large, chunk ranges stripe across the sources (a source dying "
+        "mid-transfer reassigns only its unfinished stripes).  Smaller "
+        "objects pull from the single best source."),
+    "object_transfer_raw_channel": (
+        bool, True,
+        "Move chunk payloads as codec-bypass raw frames (memoryview "
+        "slices out of the shm arena, landed straight into the ingest "
+        "buffer).  False falls back to the pickled op_read channel "
+        "(parity bisection / debugging)."),
     "pg_device_batch_min": (
         int, 2,
         "Minimum pending placement-group batch routed to the device "

@@ -71,6 +71,9 @@ def _lib():
                    "arena_largest_free"):
             getattr(lib, fn).argtypes = [u8p]
             getattr(lib, fn).restype = ctypes.c_uint64
+        lib.arena_touch.argtypes = [u8p, ctypes.c_uint64,
+                                    ctypes.c_uint64]
+        lib.arena_touch.restype = ctypes.c_uint64
         _lib_handle = lib
     return _lib_handle
 
@@ -136,6 +139,13 @@ class Arena:
     def write(self, offset: int, data) -> None:
         data = memoryview(data)
         self._view[offset:offset + data.nbytes] = data
+
+    def touch(self, offset: int, size: int) -> None:
+        """Pre-fault [offset, offset+size): one read per page, native
+        and GIL-free (ctypes releases the GIL for the call), so a
+        transfer can warm its landing block on a spare core while the
+        bytes are still on the wire."""
+        _lib().arena_touch(self._base, offset, size)
 
     def bytes_in_use(self) -> int:
         return int(_lib().arena_bytes_in_use(self._base))

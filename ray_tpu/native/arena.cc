@@ -122,6 +122,20 @@ int arena_free(uint8_t* base, uint64_t payload_off) {
   return 0;
 }
 
+// Touch one byte per page of [offset, offset+size) so first-touch
+// faults (tmpfs page allocation + zeroing) are paid here instead of
+// inside a landing memcpy.  Reads only — safe concurrent with writers
+// to the same range — and called WITHOUT the arena lock: ctypes drops
+// the GIL for the call, so a transfer can warm its ingest block on a
+// spare core while chunk bytes are in flight.
+uint64_t arena_touch(uint8_t* base, uint64_t offset, uint64_t size) {
+  const uint64_t kPage = 4096;
+  volatile uint8_t acc = 0;
+  uint64_t end = offset + size;
+  for (uint64_t off = offset; off < end; off += kPage) acc += base[off];
+  return acc;
+}
+
 uint64_t arena_bytes_in_use(uint8_t* base) {
   return ((Header*)base)->bytes_in_use;
 }

@@ -15,7 +15,9 @@ the driver<->head boundary (client mode, job submission) the way the
 reference's gRPC carries daemon-to-daemon traffic.
 """
 
-from .client import RpcClient, RpcConnectionError
+from .client import RemoteRpcError, RpcClient, RpcConnectionError, RpcFuture
 from .server import RpcServer
+from .wire import RawReply, RawResult
 
-__all__ = ["RpcServer", "RpcClient", "RpcConnectionError"]
+__all__ = ["RpcServer", "RpcClient", "RpcConnectionError",
+           "RemoteRpcError", "RpcFuture", "RawReply", "RawResult"]

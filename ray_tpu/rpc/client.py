@@ -4,6 +4,13 @@ A single connection multiplexes concurrent calls: each call gets a
 request id and parks on an event; one reader thread dispatches replies
 by id.  Server-side exceptions re-raise here with the remote traceback
 attached (SURVEY.md §1 layer 2).
+
+``call_async`` exposes the same demux as explicit futures: the object
+plane keeps a window of chunk requests in flight on one connection and
+collects completions through ``on_done`` callbacks instead of parking
+one thread per chunk.  Raw reply frames (``wire.RAW_MARKER``) resolve
+to ``RawReply`` objects whose payload is a zero-copy view into the
+receive buffer — no pickle pass on the bulk-data path.
 """
 
 from __future__ import annotations
@@ -12,7 +19,7 @@ import itertools
 import socket
 import threading
 
-from .wire import recv_frame, send_frame
+from .wire import recv_reply, send_frame
 
 
 class RpcConnectionError(ConnectionError):
@@ -34,6 +41,43 @@ class RemoteRpcError(RuntimeError):
 _UNSET = object()
 
 
+class RpcFuture:
+    """One in-flight call: ``result(timeout)`` parks; ``done()`` polls.
+    The ``on_done`` callback passed at issue time fires (no args, on the
+    reader thread) the moment the reply — or the connection's death —
+    resolves the call."""
+
+    __slots__ = ("_client", "_req_id", "_slot", "_method")
+
+    def __init__(self, client, req_id, slot, method):
+        self._client = client
+        self._req_id = req_id
+        self._slot = slot
+        self._method = method
+
+    def done(self) -> bool:
+        return self._slot[0].is_set()
+
+    def wait(self, timeout=None) -> bool:
+        """Park until the call resolves (reply or connection loss)
+        WITHOUT raising; True when resolved.  Lets a caller that
+        abandoned a call confirm no late reply is still being received
+        (e.g. straight into a sink buffer it is about to free)."""
+        return self._slot[0].wait(timeout)
+
+    def result(self, timeout=None):
+        slot = self._slot
+        if not slot[0].wait(timeout):
+            self._client._pending.pop(self._req_id, None)
+            raise TimeoutError(
+                f"rpc {self._method} timed out after {timeout}s")
+        if self._client._closed and slot[1] is None:
+            raise RpcConnectionError("connection lost awaiting reply")
+        if slot[1]:
+            return slot[2]
+        raise RemoteRpcError(*slot[2])
+
+
 class RpcClient:
     def __init__(self, address: str, timeout: float = 10.0,
                  on_close=None):
@@ -49,7 +93,8 @@ class RpcClient:
         self._sock.settimeout(None)     # calls manage their own deadlines
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
-        self._pending: dict[int, list] = {}    # id -> [event, ok, payload]
+        # id -> [event, ok, payload, on_done, sink]
+        self._pending: dict[int, list] = {}
         self._ids = itertools.count()
         self._closed = False
         self._on_close = on_close
@@ -64,8 +109,23 @@ class RpcClient:
         # (long gets/waits that manage their own deadline).
         if timeout is _UNSET:
             timeout = self._default_timeout
+        return self.call_async(method, *args, **kwargs).result(timeout)
+
+    def call_async(self, method: str, *args, on_done=None, sink=None,
+                   **kwargs) -> RpcFuture:
+        """Issue without waiting; the returned future resolves when the
+        reply lands.  ``on_done()`` (if given) is invoked from the
+        reader thread on completion — including connection loss, so a
+        windowed caller never hangs on a dead peer.
+
+        ``sink(payload_len)`` (if given) may return a writable buffer
+        for a RAW reply's payload: the bytes are then received straight
+        into it on the reader thread (kernel to final home, no frame
+        buffer) and the resolved ``RawReply.payload`` is None.  Return
+        None from the sink to fall back to the buffered receive (e.g.
+        on an unexpected length)."""
         req_id = next(self._ids)
-        slot = [threading.Event(), None, None]
+        slot = [threading.Event(), None, None, on_done, sink]
         self._pending[req_id] = slot
         try:
             with self._wlock:
@@ -75,38 +135,52 @@ class RpcClient:
         except (OSError, ConnectionError) as e:
             self._pending.pop(req_id, None)
             raise RpcConnectionError(str(e)) from e
-        if not slot[0].wait(timeout):
-            self._pending.pop(req_id, None)
-            raise TimeoutError(
-                f"rpc {method} timed out after {timeout}s")
-        if self._closed and slot[1] is None:
-            raise RpcConnectionError("connection lost awaiting reply")
-        if slot[1]:
-            return slot[2]
-        raise RemoteRpcError(*slot[2])
+        return RpcFuture(self, req_id, slot, method)
+
+    def _sink_for(self, req_id: int, payload_len: int):
+        """Wire-level sink lookup for ``recv_reply``: the registered
+        sink of the pending call, or None (buffered receive)."""
+        slot = self._pending.get(req_id)
+        if slot is None or slot[4] is None:
+            return None
+        try:
+            return slot[4](payload_len)
+        except Exception:   # noqa: BLE001 — a broken sink must not
+            return None     # kill the reader; fall back to buffering
 
     def _read_loop(self) -> None:
         while True:
             try:
-                frame = recv_frame(self._sock)
+                msg = recv_reply(self._sock, self._sink_for)
             except (ConnectionError, OSError):
-                frame = None
-            if frame is None:
+                msg = None
+            if msg is None:
                 break
-            req_id, ok, payload = frame
+            req_id, ok, payload = msg
             slot = self._pending.pop(req_id, None)
             if slot is not None:
                 slot[1], slot[2] = ok, payload
                 slot[0].set()
+                self._fire_on_done(slot)
         self._closed = True
         # wake every waiter; they observe _closed and raise
         for slot in list(self._pending.values()):
             slot[0].set()
+            self._fire_on_done(slot)
         if self._on_close is not None:
             try:
                 self._on_close()
             except Exception:       # noqa: BLE001 — cleanup must not kill
                 pass                # the reader's unwind
+
+    @staticmethod
+    def _fire_on_done(slot) -> None:
+        cb = slot[3]
+        if cb is not None:
+            try:
+                cb()
+            except Exception:   # noqa: BLE001 — a completion hook must
+                pass            # not kill the reader thread
 
     def close(self) -> None:
         self._closed = True

@@ -21,7 +21,8 @@ import socket
 import threading
 import traceback
 
-from .wire import recv_raw_frame, send_raw_frame
+from .wire import (RawResult, recv_raw_frame, send_raw_frame,
+                   send_raw_reply)
 
 
 class RpcServer:
@@ -185,6 +186,27 @@ class RpcServer:
             ok, payload = False, self._error_payload(e)
         finally:
             self._tls.conn = None
+        if ok and isinstance(payload, RawResult):
+            # data channel: the payload buffer (shm view / spill bytes)
+            # is gather-written verbatim — no pickle, no concat copy.
+            # The release hook (shm pin) runs once the socket has the
+            # bytes, success or not.
+            from ..runtime.serialization import serialize
+            try:
+                meta_bytes = serialize(payload.meta)
+                with wlock:
+                    n = send_raw_reply(conn, req_id, meta_bytes,
+                                       payload.payload)
+                self._account(method, 0, n)
+            except (OSError, ConnectionError):
+                pass            # client went away; nothing to tell it
+            finally:
+                if payload.release is not None:
+                    try:
+                        payload.release()
+                    except Exception:   # noqa: BLE001 — pin cleanup
+                        pass            # must not kill the handler
+            return
         try:
             data = self._encode_reply(req_id, ok, payload)
         except Exception as e:          # result outside the codec's subset

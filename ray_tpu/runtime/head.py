@@ -346,6 +346,8 @@ class HeadNode:
             "available_resources": api.available_resources(),
             "cluster_resources": api.cluster_resources(),
             "store": cluster.store.stats(),
+            "object_plane": cluster.plane.stats(),
+            "pulls": cluster.pull_manager.stats(),
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
         }

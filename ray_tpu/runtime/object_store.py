@@ -91,6 +91,10 @@ class RemoteEntry:
 PLASMA_KINDS = ("shm", "spill", "remote")
 
 
+def _NO_RELEASE() -> None:
+    """Release hook for buffers that hold no pin (spill reads)."""
+
+
 class MemoryStore:
     def __init__(self, arena=None, spill_dir: str | None = None,
                  direct_call_threshold: int | None = None,
@@ -301,6 +305,40 @@ class MemoryStore:
             except OSError:
                 continue        # restore/delete raced: re-check entry
         return None
+
+    def read_range_view(self, object_id: ObjectID, offset: int,
+                        length: int):
+        """Zero-copy variant of ``read_range`` for the raw data channel:
+        ``(buffer, release)`` where the buffer is an arena memoryview
+        pinned until ``release()`` runs (the RPC server calls it once
+        the bytes are on the socket), or plain spill-file bytes with a
+        no-op release.  ``(None, None)`` when the object has no local
+        bytes."""
+        for _ in range(4):
+            with self._cv:
+                entry = self._objects.get(object_id)
+                if isinstance(entry, ShmEntry):
+                    if offset < 0 or offset >= entry.size:
+                        return b"", _NO_RELEASE
+                    entry.pins += 1
+                    pin = (object_id, entry.offset)
+                    view = self.arena.view(entry.offset + offset,
+                                           min(length,
+                                               entry.size - offset))
+                    return view, lambda: self.unpin([pin])
+                if isinstance(entry, SpillEntry):
+                    if offset < 0:
+                        return b"", _NO_RELEASE
+                    path = entry.path
+                else:
+                    return None, None
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length), _NO_RELEASE
+            except OSError:
+                continue        # restore/delete raced: re-check entry
+        return None, None
 
     def begin_ingest(self, object_id: ObjectID, size: int):
         """Start receiving a remote object's bytes: returns an
@@ -705,6 +743,30 @@ class _IngestHandle:
         self._buf = buf
         self._file = open(path, "wb") if path is not None else None
         self._done = False
+
+    def prefault(self) -> None:
+        """Touch one byte per page of an arena ingest block so the
+        first-touch faults (tmpfs page allocation + zeroing — the bulk
+        of a cold landing write's cost) are paid here, overlapped with
+        the network transfer, instead of serializing into the chunk
+        landings.  Native + GIL-free (``Arena.touch``) so the walk runs
+        on a spare core instead of convoying the reader thread; reads
+        only, safe concurrent with ``write``."""
+        if self._shm is None:
+            return
+        try:
+            self._store.arena.touch(self._shm.offset, self._size)
+        except (ValueError, AttributeError):
+            pass        # arena closed mid-walk: best effort
+
+    def view(self, offset: int, length: int):
+        """Writable view of ``[offset, offset+length)`` in the landing
+        block, for receiving wire bytes straight into their final home
+        (shm ingest only; None otherwise — callers fall back to the
+        buffered receive + ``write`` path)."""
+        if self._shm is None or offset + length > self._size:
+            return None
+        return self._store.arena.view(self._shm.offset + offset, length)
 
     def write(self, offset: int, data: bytes) -> None:
         if self._shm is not None:

@@ -282,7 +282,12 @@ class PullManager:
         """Execute one transfer.  Simulated rows share the head arena
         (the transfer is a directory update, optionally paced); rows
         with a plane address move real chunks arena-to-arena — payload
-        bytes flow source→destination directly, never through here."""
+        bytes flow source→destination directly, never through here.
+
+        Beyond the cost-model-chosen primary ``src``, every OTHER
+        directory replica's plane address rides along: the destination
+        plane stripes chunk ranges across them (and fails over within
+        the transfer when the primary dies mid-stripe)."""
         planes = self._cluster.planes
         src_addr = planes.get(src)
         dest_addr = planes.get(dest)
@@ -291,16 +296,34 @@ class PullManager:
                 time.sleep(size / (self._sim_gbps * 1e9))
             return True
         plane = self._cluster.plane
+        if src_addr is None:
+            # source shares the head store: serve from the head's plane
+            src_addr = plane.serve_address
+            if src_addr is None and dest_addr is not None:
+                return False    # head store is not being served
+        extra = self._replica_addrs(oid, dest, exclude=src_addr)
         if dest_addr is None:
             # destination shares the head store: fetch here
-            return plane.pull_into_local(oid, size, src_addr)
-        # destination is an agent plane: it pulls from the source plane
-        # (the head's own serving address when the source is head-local)
-        if src_addr is None:
-            src_addr = plane.serve_address
-            if src_addr is None:
-                return False    # head store is not being served
-        return plane.request_remote_pull(dest_addr, oid, size, src_addr)
+            return plane.pull_into_local(oid, size, src_addr, extra)
+        return plane.request_remote_pull(dest_addr, oid, size, src_addr,
+                                         extra)
+
+    def _replica_addrs(self, oid, dest: int,
+                       exclude: str | None) -> tuple:
+        """Plane addresses of every directory replica besides the
+        primary (striping candidates), destination excluded."""
+        planes = self._cluster.planes
+        head_addr = self._cluster.plane.serve_address
+        out = []
+        for row in self._cluster.directory.locations(oid):
+            if row == dest:
+                continue
+            addr = planes.get(row)
+            if addr is None:
+                addr = head_addr    # head-resident replica
+            if addr is not None and addr != exclude and addr not in out:
+                out.append(addr)
+        return tuple(out)
 
     # -- loss / teardown -----------------------------------------------------
     def on_objects_lost(self, object_ids) -> None:
@@ -314,7 +337,7 @@ class PullManager:
 
     def stats(self) -> dict:
         with self._cv:
-            return {
+            out = {
                 "num_pulls": self.num_pulls,
                 "bytes_pulled": self.bytes_pulled,
                 "num_failed": self.num_failed,
@@ -323,6 +346,12 @@ class PullManager:
                 "device_batches": self.device_batches,
                 "oracle_batches": self.oracle_batches,
             }
+        # data-path counters from the local plane endpoint (per-transfer
+        # MB/s, window occupancy, stripe retries, raw vs pickled bytes)
+        plane = getattr(self._cluster, "plane", None)
+        if plane is not None:
+            out.update(plane.stats())
+        return out
 
     def shutdown(self) -> None:
         with self._cv:

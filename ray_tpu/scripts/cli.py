@@ -201,6 +201,29 @@ def cmd_status(args) -> int:
     total, avail = st["cluster_resources"], st["available_resources"]
     for name in sorted(total):
         print(f"  {avail.get(name, 0.0):.1f}/{total[name]:.1f} {name}")
+    op = st.get("object_plane")
+    if op:
+        mb = 1 << 20
+        print("object plane:")
+        print(f"  sent {op['plane_bytes_sent'] / mb:.1f} MB "
+              f"(raw {op['plane_raw_bytes_sent'] / mb:.1f} / pickled "
+              f"{op['plane_pickled_bytes_sent'] / mb:.1f})  "
+              f"received {op['plane_bytes_received'] / mb:.1f} MB "
+              f"(raw {op['plane_raw_bytes_received'] / mb:.1f} / pickled "
+              f"{op['plane_pickled_bytes_received'] / mb:.1f})")
+        print(f"  transfers in={op['plane_transfers_in']} "
+              f"failed={op['plane_transfers_failed']} "
+              f"stripe_retries={op['plane_stripe_retries']}  "
+              f"window now={op['plane_window_occupancy']} "
+              f"peak={op['plane_window_peak']}  "
+              f"last {op['plane_last_transfer_mbps']} MB/s "
+              f"(ewma {op['plane_ewma_transfer_mbps']})")
+    pulls = st.get("pulls")
+    if pulls:
+        print(f"pulls: {pulls['num_pulls']} done "
+              f"({pulls['bytes_pulled'] / (1 << 20):.1f} MB), "
+              f"{pulls['num_failed']} failed, {pulls['queued']} queued, "
+              f"{pulls['inflight_bytes'] / (1 << 20):.1f} MB in flight")
     if st["jobs"]:
         print(f"jobs ({len(st['jobs'])}):")
         for j in st["jobs"]:

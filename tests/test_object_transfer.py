@@ -330,3 +330,245 @@ class TestEndToEnd:
         cluster3.remove_node(cluster3.raylets[victim].node_id)
         with pytest.raises(ObjectLostError):
             ray_tpu.get(ref, timeout=10)
+
+
+# -- raw-channel striped transfers (plane-level, no full cluster) ----------
+
+class _Endpoint:
+    """One standalone plane endpoint: own arena + store + RPC server."""
+
+    def __init__(self, tmp, name, arena_mb=64):
+        import os
+        from ray_tpu.native import Arena
+        from ray_tpu.rpc import RpcServer
+        from ray_tpu.runtime.object_plane import ObjectPlane
+        from ray_tpu.runtime.object_store import MemoryStore
+        self.arena = Arena(os.path.join(tmp, f"arena_{name}"),
+                           arena_mb << 20, create=True)
+        self.store = MemoryStore(
+            arena=self.arena, spill_dir=os.path.join(tmp, f"sp_{name}"))
+        self.plane = ObjectPlane(self.store)
+        self.server = RpcServer({}).start()
+        self.plane.attach(self.server)
+
+    @property
+    def address(self):
+        return self.server.address
+
+    def seal(self, oid, payload: bytes) -> int:
+        from ray_tpu.runtime.serialization import serialize
+        self.store.put_serialized(oid, serialize(payload))
+        kind, size = self.store.plasma_info(oid)
+        assert kind == "shm", kind
+        return size
+
+    def stop(self):
+        self.plane.shutdown()
+        self.server.stop()
+
+
+@pytest.fixture
+def endpoints(tmp_path):
+    made = []
+
+    def make(name, arena_mb=64):
+        ep = _Endpoint(str(tmp_path), name, arena_mb)
+        made.append(ep)
+        return ep
+
+    try:
+        yield make
+    finally:
+        for ep in made:
+            ep.stop()
+
+
+class TestStripedPlane:
+    def _payload(self, n):
+        import hashlib
+        out = bytearray()
+        i = 0
+        while len(out) < n:
+            out += hashlib.sha256(str(i).encode()).digest()
+            i += 1
+        return bytes(out[:n])
+
+    def test_striped_assembly_matches_serial_pull(self, endpoints):
+        """Byte-for-byte parity: a 2-source striped pull assembles the
+        exact bytes a single-source serial (window=1) pull does."""
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_stripe_min_mb": 2,
+                      "object_transfer_window": 4})
+        payload = self._payload(6 << 20)
+        src1, src2 = endpoints("src1"), endpoints("src2")
+        oid = _oid()
+        size = src1.seal(oid, payload)
+        assert src2.seal(oid, payload) == size
+
+        striped = endpoints("dest_striped")
+        assert striped.plane.pull_into_local(
+            oid, size, src1.address, (src2.address,))
+
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_stripe_min_mb": 2,
+                      "object_transfer_window": 1})
+        serial = endpoints("dest_serial")
+        assert serial.plane.pull_into_local(oid, size, src1.address)
+
+        a = striped.store.read_range(oid, 0, size)
+        b = serial.store.read_range(oid, 0, size)
+        assert a == b and len(a) == size
+        assert striped.store.peek(oid) == payload
+        # the stripes really came from BOTH sources, over the raw channel
+        assert src1.plane.bytes_sent_raw > 0
+        assert src2.plane.bytes_sent_raw > 0
+        assert striped.plane.bytes_received_raw >= size
+        s = striped.plane.stats()
+        assert s["plane_last_transfer_mbps"] > 0
+        assert s["plane_window_occupancy"] == 0
+
+    def test_pickled_fallback_parity(self, endpoints):
+        """object_transfer_raw_channel=False restores the pickled
+        op_read channel — same bytes, different framing."""
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_raw_channel": False})
+        payload = self._payload(3 << 20)
+        src = endpoints("src")
+        oid = _oid()
+        size = src.seal(oid, payload)
+        dest = endpoints("dest")
+        assert dest.plane.pull_into_local(oid, size, src.address)
+        assert dest.store.peek(oid) == payload
+        assert dest.plane.bytes_received_pickled >= size
+        assert dest.plane.bytes_received_raw == 0
+        assert src.plane.bytes_sent_pickled >= size
+
+    def test_window_respects_inflight_quota(self, endpoints):
+        """The pipelining window is capped by the pull manager's
+        in-flight byte quota: quota/chunk outstanding requests, never
+        the configured window when that is larger."""
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_window": 32,
+                      "pull_manager_max_inflight_mb": 2,
+                      "object_transfer_stripe_min_mb": 1024})
+        payload = self._payload(10 << 20)
+        src = endpoints("src")
+        oid = _oid()
+        size = src.seal(oid, payload)
+        dest = endpoints("dest")
+        assert dest.plane.pull_into_local(oid, size, src.address)
+        assert dest.store.peek(oid) == payload
+        assert 1 <= dest.plane.window_peak <= 2, \
+            dest.plane.window_peak
+
+    def test_small_object_single_round_trip(self, endpoints):
+        """The stat piggybacks on chunk 0: a sub-chunk object moves in
+        ONE data-plane request (no separate op_stat round-trip)."""
+        Config.reset({"object_transfer_chunk_mb": 4})
+        payload = self._payload(300_000)
+        src = endpoints("src")
+        oid = _oid()
+        size = src.seal(oid, payload)
+        dest = endpoints("dest")
+        assert dest.plane.pull_into_local(oid, size, src.address)
+        assert dest.store.peek(oid) == payload
+        assert src.server.method_calls.get("op_fetch") == 1
+        assert "op_stat" not in src.server.method_calls
+
+    def test_dead_primary_fails_over_before_first_chunk(self, endpoints):
+        """A dead primary address must not sink the pull when another
+        replica is live."""
+        Config.reset({"object_transfer_chunk_mb": 1})
+        payload = self._payload(2 << 20)
+        src = endpoints("src")
+        oid = _oid()
+        size = src.seal(oid, payload)
+        ghost = endpoints("ghost")
+        ghost_addr = ghost.address
+        ghost.stop()                    # dead before the transfer starts
+        dest = endpoints("dest")
+        assert dest.plane.pull_into_local(oid, size, ghost_addr,
+                                          (src.address,))
+        assert dest.store.peek(oid) == payload
+
+
+_CHAOS_CHILD = r"""
+import os, sys, time
+from ray_tpu.common.config import Config
+Config.reset({"object_store_memory_mb": 64})
+from ray_tpu.common.ids import ObjectID
+from ray_tpu.native import Arena
+from ray_tpu.rpc import RpcServer
+from ray_tpu.runtime.object_plane import ObjectPlane
+from ray_tpu.runtime.object_store import MemoryStore
+from ray_tpu.runtime.serialization import serialize
+
+tmp, oid_hex, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+arena = Arena(os.path.join(tmp, "child_arena"), 64 << 20, create=True)
+store = MemoryStore(arena=arena, spill_dir=os.path.join(tmp, "child_sp"))
+store.put_serialized(ObjectID.from_hex(oid_hex),
+                     serialize(b"\xa5" * n))
+plane = ObjectPlane(store)
+server = RpcServer({}).start()
+plane.attach(server)
+print(server.address, flush=True)
+time.sleep(600)
+"""
+
+
+@pytest.mark.chaos
+class TestStripeSourceDeath:
+    def test_sigkill_source_mid_stripe_converges(self, endpoints,
+                                                 tmp_path):
+        """SIGKILL one of two stripe sources mid-transfer: its
+        unfinished stripes reassign to the survivor and the pull
+        completes with zero failed transfers."""
+        import signal
+        import subprocess
+        import sys
+        import threading as _threading
+
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_stripe_min_mb": 2,
+                      "object_transfer_window": 2})
+        n = 24 << 20
+        payload = b"\xa5" * n
+        oid = _oid()
+
+        child = subprocess.Popen(
+            [sys.executable, "-c", _CHAOS_CHILD, str(tmp_path),
+             oid.hex(), str(n)],
+            stdout=subprocess.PIPE, text=True,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            child_addr = child.stdout.readline().strip()
+            assert ":" in child_addr, "child did not come up"
+
+            survivor = endpoints("survivor", arena_mb=96)
+            size = survivor.seal(oid, payload)
+            dest = endpoints("dest", arena_mb=96)
+
+            result = []
+            t = _threading.Thread(
+                target=lambda: result.append(
+                    dest.plane.pull_into_local(
+                        oid, size, child_addr, (survivor.address,))),
+                daemon=True)
+            t.start()
+            # kill the child once the window is provably occupied
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not result:
+                if dest.plane.window_occupancy > 0 or \
+                        dest.plane.bytes_received:
+                    break
+                time.sleep(0.002)
+            child.send_signal(signal.SIGKILL)
+            t.join(90)
+            assert result == [True], "striped pull did not converge"
+            assert dest.plane.transfers_failed == 0
+            # zero failed gets: the bytes are exact
+            assert dest.store.peek(oid) == payload
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait(10)
