@@ -1,0 +1,165 @@
+"""Serve request-plane benchmark: micro-batching and overload shedding.
+
+Two experiments against a live single-node cluster:
+
+- **batching**: a model that admits ONE inference stream (a lock around
+  a fixed ~8 ms compute step) served unbatched vs through
+  ``@serve.batch`` — the batcher amortizes the per-invocation cost
+  across coalesced requests, so batched throughput must be >= 2x
+  unbatched.
+- **overload**: the HTTP ingress at ~2x sustainable load (16 closed-loop
+  clients against 4 replica slots + a queue of 8).  Admission control
+  must SHED the excess (503 + Retry-After) while the p99 latency of the
+  ACCEPTED requests stays bounded by queue depth, not by offered load.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import threading
+import time
+
+N_REQUESTS = 160        # per throughput run
+STEP_S = 0.008          # per-invocation model cost
+HTTP_SECONDS = 2.5      # overload measurement window
+HTTP_CLIENTS = 16
+
+
+def _throughput(handle, n=N_REQUESTS) -> float:
+    import ray_tpu
+    t0 = time.perf_counter()
+    out = ray_tpu.get([handle.remote(i) for i in range(n)], timeout=120)
+    dt = time.perf_counter() - t0
+    assert out == list(range(n)), "bad results"
+    return n / dt
+
+
+def bench_batching() -> tuple[float, float]:
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Unbatched:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def __call__(self, x):
+            with self._lock:            # one inference stream
+                time.sleep(STEP_S)
+            return x
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Batched:
+        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005)
+        def __call__(self, items):
+            time.sleep(STEP_S)          # one step serves the batch
+            return items
+
+    handle = serve.run(Unbatched.bind())
+    _throughput(handle, 32)             # warmup
+    unbatched = _throughput(handle)
+    serve.delete("default")
+
+    handle = serve.run(Batched.bind())
+    _throughput(handle, 32)             # warmup
+    batched = _throughput(handle)
+    serve.delete("default")
+    return unbatched, batched
+
+
+def bench_overload() -> dict:
+    from urllib import error, request as urlreq
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=4,
+                      max_queued_requests=8)
+    class Busy:
+        def __call__(self, request):
+            time.sleep(0.02)
+            return "ok"
+
+    serve.run(Busy.bind(), route_prefix="/bench")
+    url = f"{serve.http_address()}/bench"
+    ok_lat: list[float] = []
+    shed = [0]
+    retry_after = [0]
+    lock = threading.Lock()
+    stop = time.perf_counter() + HTTP_SECONDS
+
+    def client():
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            try:
+                with urlreq.urlopen(url, timeout=30) as r:
+                    r.read()
+                    code = r.status
+            except error.HTTPError as e:
+                e.read()
+                code = e.code
+                if e.headers.get("Retry-After"):
+                    with lock:
+                        retry_after[0] += 1
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                if code == 200:
+                    ok_lat.append(dt)
+                elif code == 503:
+                    shed[0] += 1
+            if code == 503:
+                # brief backoff so the closed loop offers ~2x capacity
+                # instead of a hot retry storm
+                time.sleep(0.05)
+
+    threads = [threading.Thread(target=client)
+               for _ in range(HTTP_CLIENTS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.delete("default")
+
+    ok_lat.sort()
+    n = len(ok_lat)
+    total = n + shed[0]
+    return {
+        "qps": n / wall,
+        "p50_ms": ok_lat[n // 2] if n else 0.0,
+        "p99_ms": ok_lat[min(n - 1, int(n * 0.99))] if n else 0.0,
+        "shed_rate": shed[0] / total if total else 0.0,
+        "retry_after_on_all_503s": retry_after[0] == shed[0],
+    }
+
+
+def main():
+    import ray_tpu
+    ray_tpu.init(resources={"CPU": 12, "memory": 8}, num_workers=6)
+    try:
+        unbatched, batched = bench_batching()
+        http = bench_overload()
+    finally:
+        from ray_tpu import serve
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+    speedup = batched / unbatched
+    print(json.dumps({
+        "metric": f"serve: unbatched {unbatched:.0f} | batched "
+                  f"{batched:.0f} req/s"
+                  + ("" if speedup >= 2 else " [SPEEDUP < 2x]")
+                  + f"; 2x-overload ingress {http['qps']:.0f} QPS, "
+                  f"p50 {http['p50_ms']:.0f} ms, "
+                  f"p99 {http['p99_ms']:.0f} ms, "
+                  f"shed {http['shed_rate'] * 100:.0f}%"
+                  + ("" if http["retry_after_on_all_503s"]
+                     else " [503 MISSING Retry-After]"),
+        "value": round(batched, 1),
+        "unit": "req/s",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

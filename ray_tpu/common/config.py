@@ -227,6 +227,21 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "tpu_group_capacity": (
         int, 128,
         "Padded number of distinct scheduling classes per device batch."),
+    # -- serve request plane ------------------------------------------------
+    "serve_max_queued_requests": (
+        int, 200,
+        "Default per-deployment bound on requests queued in the "
+        "RequestRouter while every replica is at max_ongoing_requests; "
+        "a full queue sheds with BackPressureError (HTTP 503). "
+        "Override per deployment via max_queued_requests."),
+    "serve_retry_after_s": (
+        float, 1.0,
+        "Retry-After hint (seconds) the ingress attaches to 503 "
+        "load-shed responses."),
+    "serve_latency_ewma_alpha": (
+        float, 0.2,
+        "Smoothing factor for the per-deployment request-latency EWMA "
+        "the router feeds the autoscaler (higher = more reactive)."),
     # -- observability ------------------------------------------------------
     "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
     "dashboard_port": (int, 0, "0 disables the dashboard HTTP server."),

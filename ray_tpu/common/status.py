@@ -45,6 +45,12 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class BackPressureError(RayTpuError):
+    """A deployment's bounded request queue is full; the request was
+    shed instead of queued (reference: ``serve.exceptions.BackPressureError``
+    raised when ``max_queued_requests`` is exceeded)."""
+
+
 class WorkerCrashedError(RayTpuError):
     pass
 

@@ -89,6 +89,12 @@ class Dashboard(BackgroundHTTPServer):
             return {f"{r}:{i}": text for (r, i), text in got.items()}
         if name == "jobs":
             return self._jobs.list() if self._jobs is not None else []
+        if name == "serve":
+            try:
+                from ..serve.router import request_plane_stats
+                return request_plane_stats()
+            except Exception:   # noqa: BLE001 — serve absent/unused
+                return {}
         return None
 
     def _summary(self, nodes=None, actors=None, tasks=None) -> dict:
@@ -159,6 +165,19 @@ class Dashboard(BackgroundHTTPServer):
             sections += ["<h2>Jobs</h2>",
                          table(s["jobs"],
                                ["job_id", "status", "entrypoint"])]
+        try:
+            from ..serve.router import request_plane_stats
+            plane = request_plane_stats()
+        except Exception:   # noqa: BLE001 — serve absent/unused
+            plane = {}
+        if plane:
+            rows = [dict(v, deployment=k) for k, v in
+                    sorted(plane.items())]
+            sections += [
+                "<h2>Serve request plane</h2>",
+                table(rows, ["deployment", "replicas", "inflight",
+                             "queued", "qps", "p50_ms", "p99_ms",
+                             "shed", "expired", "batch_size_mean"])]
         sections.append(
             '<p>APIs: <a href="/api/summary">summary</a> · '
             '<a href="/api/nodes">nodes</a> · '
@@ -166,6 +185,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/tasks">tasks</a> · '
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
+            '<a href="/api/serve">serve</a> · '
             '<a href="/api/stacks">stacks</a> · '
             '<a href="/api/timeline">timeline</a> · '
             '<a href="/api/jobs">jobs</a> · '

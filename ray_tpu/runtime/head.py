@@ -350,7 +350,18 @@ class HeadNode:
             "pulls": cluster.pull_manager.stats(),
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
+            "serve": self._serve_stats(),
         }
+
+    @staticmethod
+    def _serve_stats() -> dict:
+        # per-deployment request-plane stats; only populated when serve
+        # apps run in this process (the router registry is local)
+        try:
+            from ..serve.router import request_plane_stats
+            return request_plane_stats()
+        except Exception:   # noqa: BLE001 — serve absent/unused
+            return {}
 
     def _nodes(self) -> list[dict]:
         from .. import api

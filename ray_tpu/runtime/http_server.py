@@ -68,10 +68,12 @@ class BackgroundHTTPServer:
 
     @staticmethod
     def reply(request, body: bytes, content_type: str,
-              status: int = 200) -> None:
+              status: int = 200, headers: dict | None = None) -> None:
         request.send_response(status)
         request.send_header("Content-Type", content_type)
         request.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            request.send_header(k, str(v))
         request.end_headers()
         request.wfile.write(body)
 

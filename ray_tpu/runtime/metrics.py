@@ -113,6 +113,43 @@ def render_metrics(cluster) -> str:
         _fmt("events_emitted_total", ev.num_events,
              "Structured events emitted (cumulative)", out=out)
 
+    # serve request plane (per-deployment, only when apps run in this
+    # process — the router registry is process-local)
+    try:
+        from ..serve.router import request_plane_stats
+        plane = request_plane_stats()
+    except Exception:   # noqa: BLE001 — serve absent/unused
+        plane = {}
+    for dep, s in sorted(plane.items()):
+        lbl = {"deployment": dep}
+        _fmt("serve_replicas", s.get("replicas", 0),
+             "Live replicas", lbl, out)
+        _fmt("serve_queued_requests", s.get("queued", 0),
+             "Requests parked in the router queue", lbl, out)
+        _fmt("serve_inflight_requests", s.get("inflight", 0),
+             "Requests dispatched and unfinished", lbl, out)
+        _fmt("serve_qps", s.get("qps", 0),
+             "Completed requests per second (5s window)", lbl, out)
+        _fmt("serve_latency_p50_ms", s.get("p50_ms", 0),
+             "Request latency p50 (recent window)", lbl, out)
+        _fmt("serve_latency_p99_ms", s.get("p99_ms", 0),
+             "Request latency p99 (recent window)", lbl, out)
+        _fmt("serve_latency_ewma_ms", s.get("latency_ewma_ms", 0),
+             "Request latency EWMA (autoscaler signal)", lbl, out)
+        _fmt("serve_shed_requests_total", s.get("shed", 0),
+             "Requests shed by admission control (cumulative)", lbl,
+             out)
+        _fmt("serve_expired_requests_total", s.get("expired", 0),
+             "Requests dropped at deadline before dispatch "
+             "(cumulative)", lbl, out)
+        _fmt("serve_completed_requests_total", s.get("completed", 0),
+             "Requests completed (cumulative)", lbl, out)
+        if s.get("batches"):
+            _fmt("serve_batches_total", s["batches"],
+                 "Micro-batches executed (cumulative)", lbl, out)
+            _fmt("serve_batch_size_mean", s["batch_size_mean"],
+                 "Mean micro-batch size", lbl, out)
+
     # user-defined metrics (ray_tpu.util.metrics) share the endpoint
     from ..util.metrics import render_user_metrics
     out.extend(render_user_metrics())

@@ -655,6 +655,16 @@ class MemoryStore:
         with self._cv:
             return self._descriptor_locked(object_id)
 
+    def error_of(self, object_id: ObjectID):
+        """Non-blocking: the stored ``RayTaskError`` if this object's
+        entry is an in-band error value, else None.  Error results are
+        always stored in-band (never shm/spill), so this never
+        materializes a data payload — completion observers use it to
+        classify a sealed result without paying a deserialize."""
+        with self._cv:
+            e = self._objects.get(object_id)
+        return e if isinstance(e, RayTaskError) else None
+
     # -- listeners (dependency manager hook) --------------------------------
     def on_ready(self, object_id: ObjectID,
                  callback: Callable[[ObjectID], None]) -> None:

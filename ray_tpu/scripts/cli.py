@@ -228,6 +228,23 @@ def cmd_status(args) -> int:
         print(f"jobs ({len(st['jobs'])}):")
         for j in st["jobs"]:
             print(f"  {j['job_id']}  {j['status']:<10} {j['entrypoint']}")
+    plane = st.get("serve") or {}
+    if plane:
+        print(f"serve deployments ({len(plane)}):")
+        for name in sorted(plane):
+            s = plane[name]
+            line = (f"  {name}  replicas={s.get('replicas', 0)} "
+                    f"inflight={s.get('inflight', 0)} "
+                    f"queued={s.get('queued', 0)} "
+                    f"qps={s.get('qps', 0)} "
+                    f"p50={s.get('p50_ms', 0)}ms "
+                    f"p99={s.get('p99_ms', 0)}ms "
+                    f"shed={s.get('shed', 0)} "
+                    f"expired={s.get('expired', 0)}")
+            if s.get("batches"):
+                line += (f" batches={s['batches']}"
+                         f"(mean={s['batch_size_mean']})")
+            print(line)
     return 0
 
 

@@ -10,13 +10,17 @@ proxy routes ``route_prefix`` requests into the replica sets — SURVEY.md
 §1 layer 14; mount empty.
 """
 
+from ..common.status import BackPressureError
+from .batching import batch
 from .deployment import (Application, Deployment, DeploymentHandle,
                          delete, deployment, get_deployment_handle,
                          get_multiplexed_model_id, http_address,
                          multiplexed, run, shutdown, start, status)
 from .http_proxy import HTTPRequest
+from .router import RequestRouter
 
-__all__ = ["Application", "Deployment", "DeploymentHandle", "delete",
-           "deployment", "get_deployment_handle",
-           "get_multiplexed_model_id", "http_address", "HTTPRequest",
-           "multiplexed", "run", "shutdown", "start", "status"]
+__all__ = ["Application", "BackPressureError", "batch", "Deployment",
+           "DeploymentHandle", "delete", "deployment",
+           "get_deployment_handle", "get_multiplexed_model_id",
+           "http_address", "HTTPRequest", "multiplexed",
+           "RequestRouter", "run", "shutdown", "start", "status"]

@@ -118,13 +118,19 @@ def multiplexed(fn=None, *, max_num_models_per_replica: int = 3):
 class _ReplicaShell:
     """Hosts one user replica object and settles its load accounting.
 
-    The GCS KV inflight counter is incremented by the HANDLE at submit
-    (so queued requests count toward autoscaling) and decremented HERE
-    when execution completes.  Replicas run as threaded actors
-    (``max_concurrency`` = the deployment's ``max_ongoing_requests``),
-    so a slow request does not head-of-line-block the others — the
-    worker's reader-thread frame routing makes the shared pipe safe
-    for concurrent calls.
+    The GCS KV inflight counter is incremented by the ``RequestRouter``
+    at dispatch (so submitted-but-unfinished calls count toward
+    autoscaling) and decremented HERE when execution completes.
+    Replicas run as threaded actors (``max_concurrency`` = the
+    deployment's ``max_ongoing_requests``), so a slow request does not
+    head-of-line-block the others — the worker's reader-thread frame
+    routing makes the shared pipe safe for concurrent calls.
+
+    The shell also publishes a per-call context for ``@serve.batch``
+    wrappers on the user object: the deployment's KV key base (batch
+    histograms aggregate cluster-wide) and the replica's LIVE call
+    count, which lets a batch leader cut its window early once every
+    in-flight call has joined the batch.
     """
 
     def __init__(self, target_bytes: bytes, init_args: bytes,
@@ -134,6 +140,14 @@ class _ReplicaShell:
         args, kwargs = deserialize(init_args)
         self._obj = target(*args, **kwargs)
         self._kv_key = kv_key.encode()
+        self._kv_base = kv_key.split("-", 1)[1] if "-" in kv_key \
+            else kv_key
+        self._active = 0
+        self._active_lock = threading.Lock()
+
+    def _active_count(self) -> int:
+        with self._active_lock:
+            return self._active
 
     def __serve_call__(self, method: str, args: tuple, kwargs: dict,
                        model_id: str = ""):
@@ -141,8 +155,14 @@ class _ReplicaShell:
 
         from ray_tpu.experimental.internal_kv import _internal_kv_incr
 
+        from .batching import _shell_ctx
+
         def settle():
             _internal_kv_incr(self._kv_key, -1, namespace="serve")
+        with self._active_lock:
+            self._active += 1
+        shell_token = _shell_ctx.set(
+            {"kv_base": self._kv_base, "active": self._active_count})
         token = _mux_var().set(model_id) if model_id else None
         try:
             out = getattr(self._obj, method)(*args, **kwargs)
@@ -152,6 +172,9 @@ class _ReplicaShell:
         finally:
             if token is not None:
                 _mux_var().reset(token)
+            _shell_ctx.reset(shell_token)
+            with self._active_lock:
+                self._active -= 1
         if inspect.isgenerator(out):
             # a STREAMING response stays in the inflight count until
             # the stream finishes — calling the generator function
@@ -193,14 +216,18 @@ class _Controller:
 
     def __init__(self, cls_or_fn_bytes: bytes, init_args: bytes,
                  num_replicas: int, autoscaling: dict | None,
-                 actor_options: dict, max_ongoing_requests: int = 4):
+                 actor_options: dict, max_ongoing_requests: int = 4,
+                 max_queued_requests: int = 200, name: str = ""):
         import os
         self._target_bytes = cls_or_fn_bytes
         self._init_args_bytes = init_args
         self._autoscaling = autoscaling
         self._actor_options = dict(actor_options)
         self._max_ongoing = max(int(max_ongoing_requests), 1)
-        self._kv_key = f"inflight-{os.urandom(6).hex()}"
+        self._max_queued = max(int(max_queued_requests), 0)
+        self._name = name
+        self._kv_base = os.urandom(6).hex()
+        self._kv_key = f"inflight-{self._kv_base}"
         self._replicas: list = []
         self._version = 0
         self._last_scale = time.monotonic()
@@ -233,7 +260,12 @@ class _Controller:
 
     # -- handle-facing -------------------------------------------------------
     def get_replicas(self):
-        return self._version, list(self._replicas), self._kv_key
+        return self._version, list(self._replicas), self._kv_key, {
+            "max_ongoing": self._max_ongoing,
+            "max_queued": self._max_queued,
+            "name": self._name,
+            "base": self._kv_base,
+        }
 
     def ensure_replica(self):
         """Cold start for scale-to-zero: a request arrived while no
@@ -247,10 +279,23 @@ class _Controller:
         self._maybe_scale()
         return None
 
-    def _inflight(self) -> int:
-        from ray_tpu.experimental.internal_kv import _internal_kv_incr
-        return _internal_kv_incr(self._kv_key.encode(), 0,
-                                 namespace="serve")
+    def _signals(self) -> tuple[int, int, float]:
+        """The router-maintained load signals for this deployment:
+        (dispatched-but-unfinished, queued awaiting a free slot,
+        request-latency EWMA in ms)."""
+        from ray_tpu.experimental.internal_kv import (_internal_kv_get,
+                                                      _internal_kv_incr)
+        inflight = _internal_kv_incr(self._kv_key.encode(), 0,
+                                     namespace="serve")
+        queued = _internal_kv_incr(f"queued-{self._kv_base}".encode(),
+                                   0, namespace="serve")
+        raw = _internal_kv_get(f"lat-{self._kv_base}".encode(),
+                               namespace="serve")
+        try:
+            lat_ms = float(raw) if raw else 0.0
+        except ValueError:
+            lat_ms = 0.0
+        return inflight, queued, lat_ms
 
     def _maybe_scale(self) -> None:
         auto = self._autoscaling
@@ -262,21 +307,43 @@ class _Controller:
         target = max(auto.get("target_ongoing_requests", 2), 1)
         lo = auto.get("min_replicas", 1)
         hi = auto.get("max_replicas", 4)
-        inflight = self._inflight()
-        want = max(lo, min(hi, -(-inflight // target)))
+        inflight, queued, lat_ms = self._signals()
+        # demand = executing + queued: a bounded router queue means
+        # raw inflight alone UNDERCOUNTS pressure (requests the router
+        # is holding back never show up in the replica counter)
+        demand = inflight + queued
+        want = max(lo, min(hi, -(-demand // target)))
+        target_lat = auto.get("target_latency_ms", 0.0)
+        if target_lat and lat_ms > target_lat \
+                and want <= len(self._replicas) < hi:
+            # latency-EWMA escape hatch: per-replica load looks on
+            # target but requests are SLOW — add capacity anyway
+            want = len(self._replicas) + 1
         if want > len(self._replicas):
             while len(self._replicas) < want:
                 self._start_replica()
             self._last_scale = now
-        elif want < len(self._replicas) and \
+        elif want < len(self._replicas) and queued == 0 and \
                 now - self._last_scale > auto.get("downscale_delay_s",
                                                   1.0):
+            # never downscale with a backlog: the queue would re-pack
+            # the survivors and immediately re-trigger an upscale
             while len(self._replicas) > want:
                 self._stop_replica()
             self._last_scale = now
 
     def num_replicas(self) -> int:
         return len(self._replicas)
+
+    def stats(self) -> dict:
+        """Controller-side view of the request-plane load signals
+        (``serve.status`` merges this with the driver router's
+        counters)."""
+        inflight, queued, lat_ms = self._signals()
+        return {"deployment": self._name,
+                "replicas": len(self._replicas),
+                "inflight": inflight, "queued": queued,
+                "latency_ewma_ms": lat_ms}
 
     def shutdown(self) -> None:
         import ray_tpu
@@ -288,148 +355,58 @@ class _Controller:
 # -- handle ------------------------------------------------------------------
 
 class DeploymentHandle:
-    """Routes ``.remote`` calls across the deployment's replicas with
-    power-of-two-choices on locally-observed outstanding requests
-    (upstream's router picks the less-loaded of two random replicas
-    from its cached load view; here the handle's own in-flight counts
-    are that view, settled by seal callbacks in the driver).
+    """Facade over the deployment's ``RequestRouter``: ``.remote()``
+    submits through the shared per-controller router, which enforces
+    the per-replica in-flight cap, the bounded queue, and deadline
+    propagation (see ``serve/router.py``).  Every handle variant
+    produced by ``options()`` routes through the SAME router, so the
+    load view and the admission bound stay coherent across callers.
 
-    Serializable (carries only the controller's actor handle), so
-    deployments compose: pass one deployment's handle to another's
-    ``bind``.
+    Serializable (carries only the controller's actor handle plus the
+    call options), so deployments compose: pass one deployment's handle
+    to another's ``bind``.
     """
 
     def __init__(self, controller_handle, method: str = "__call__",
-                 stream: bool = False, multiplexed_model_id: str = ""):
+                 stream: bool = False, multiplexed_model_id: str = "",
+                 timeout_s: float | None = None):
         self._controller = controller_handle
         self._method = method
         self._stream = stream
         self._mux_id = multiplexed_model_id
-        self._lock = threading.Lock()
-        self._version = -1
-        self._replicas: list = []
-        self._kv_key: bytes = b""
-        self._rr = 0
-        # locally-observed outstanding calls per replica index — the
-        # router's load view (reset on refresh: replica set changed)
-        self._outstanding: dict[bytes, int] = {}
+        self._timeout_s = timeout_s
 
     def options(self, *, method_name: str | None = None,
                 stream: bool | None = None,
-                multiplexed_model_id: str | None = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: str | None = None,
+                timeout_s: float | None = None) -> "DeploymentHandle":
         """``stream=True``: calls return an ObjectRefGenerator — the
         replica method must be a generator; items stream back with
         backpressure (reference: handle.options(stream=True)).
         ``multiplexed_model_id``: route every call for this model to
         the same replica (rendezvous hashing) so its ``@multiplexed``
-        LRU cache stays hot."""
+        LRU cache stays hot.  ``timeout_s``: per-request deadline —
+        a request still queued in the router when it expires is
+        DROPPED before dispatch and its ref raises
+        ``GetTimeoutError``."""
         return DeploymentHandle(
             self._controller,
             method_name if method_name is not None else self._method,
             stream if stream is not None else self._stream,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._mux_id)
-
-    def _refresh(self) -> None:
-        version, replicas, kv_key = _api().get(
-            self._controller.get_replicas.remote(), timeout=30)
-        if version != self._version:
-            self._outstanding.clear()
-        self._version, self._replicas = version, replicas
-        self._kv_key = kv_key.encode()
-
-    def _pick_replica(self):
-        """Power of two choices on the local outstanding view; ties and
-        the single-replica case fall back to round robin.  A
-        multiplexed model id overrides with rendezvous hashing: one
-        model's calls stick to one replica (until the replica set
-        changes), keeping its LRU model cache hot."""
-        import random
-        n = len(self._replicas)
-        if self._mux_id and n > 1:
-            import hashlib
-            self._rr += 1
-            return max(
-                self._replicas,
-                key=lambda rep: hashlib.md5(
-                    rep._actor_id.binary()
-                    + self._mux_id.encode()).digest())
-        if n == 1:
-            self._rr += 1
-            return self._replicas[0]
-        i, j = random.sample(range(n), 2)
-        li = self._outstanding.get(
-            self._replicas[i]._actor_id.binary(), 0)
-        lj = self._outstanding.get(
-            self._replicas[j]._actor_id.binary(), 0)
-        if li == lj:
-            pick = (i, j)[self._rr % 2]
-        else:
-            pick = i if li < lj else j
-        self._rr += 1
-        return self._replicas[pick]
-
-    def _settle(self, replica_key: bytes, ref) -> None:
-        """Decrement the local load view when the reply seals.  Only a
-        driver-side handle can observe completion (store seal
-        callbacks); client/worker handles decrement IMMEDIATELY — their
-        view degenerates to round-robin rather than accumulating
-        lifetime totals that would invert the load signal."""
-        def done(_oid=None):
-            with self._lock:
-                c = self._outstanding.get(replica_key, 0)
-                if c > 0:
-                    self._outstanding[replica_key] = c - 1
-        try:
-            from ray_tpu.api import _get_runtime
-            store = getattr(_get_runtime(), "store", None)
-        except Exception:   # noqa: BLE001
-            store = None
-        if store is None:
-            done()
-            return
-        store.on_ready(ref.id, done)
+            else self._mux_id,
+            timeout_s if timeout_s is not None else self._timeout_s)
 
     def remote(self, *args, **kwargs):
-        from ray_tpu.actor_api import ActorMethod
-        from ray_tpu.experimental.internal_kv import _internal_kv_incr
-        with self._lock:
-            if not self._replicas or self._rr % 16 == 0:
-                self._refresh()     # pick up scaling every few calls
-            if not self._replicas:
-                # scale-to-zero cold start: ask for a replica, blocking
-                _api().get(self._controller.ensure_replica.remote(),
-                           timeout=60)
-                self._refresh()
-            replica = self._pick_replica()
-            rkey = replica._actor_id.binary()
-            self._outstanding[rkey] = self._outstanding.get(rkey, 0) + 1
-        # queued-request accounting: +1 BEFORE submit so backlog (not
-        # just executing calls) drives upscaling; the replica shell
-        # decrements on completion
-        _internal_kv_incr(self._kv_key, 1, namespace="serve")
-        self._controller.tick.remote()      # fire-and-forget scale poke
-        if self._stream:
-            gen = ActorMethod(replica, "__serve_call__",
-                              num_returns="streaming").remote(
-                self._method, args, kwargs, self._mux_id)
-            # streaming load settles optimistically (no single seal to
-            # observe); the KV inflight decrements at generator return
-            with self._lock:
-                c = self._outstanding.get(rkey, 0)
-                if c > 0:
-                    self._outstanding[rkey] = c - 1
-            return gen
-        ref = ActorMethod(replica, "__serve_call__").remote(
-            self._method, args, kwargs, self._mux_id)
-        self._settle(rkey, ref)
-        return ref
+        from .router import RequestRouter
+        return RequestRouter.for_controller(self._controller).submit(
+            self._method, args, kwargs, self._mux_id, self._stream,
+            self._timeout_s)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._controller, self._method, self._stream,
-                 self._mux_id))
+                 self._mux_id, self._timeout_s))
 
 
 # -- deployment / application ------------------------------------------------
@@ -455,19 +432,25 @@ class Deployment:
                  num_replicas: int = 1,
                  autoscaling_config: dict | None = None,
                  ray_actor_options: dict | None = None,
-                 max_ongoing_requests: int = 4):
+                 max_ongoing_requests: int = 4,
+                 max_queued_requests: int | None = None):
         self._target = target
         self.name = name
         self._num_replicas = num_replicas
         self._autoscaling = autoscaling_config
         self._actor_options = dict(ray_actor_options or {})
         self._max_ongoing = max_ongoing_requests
+        # None => the serve_max_queued_requests config default,
+        # resolved in the DRIVER at run() time (workers may not share
+        # the driver's system_config overrides)
+        self._max_queued = max_queued_requests
 
     def options(self, *, num_replicas: int | None = None,
                 autoscaling_config: dict | None = None,
                 ray_actor_options: dict | None = None,
                 name: str | None = None,
-                max_ongoing_requests: int | None = None) -> "Deployment":
+                max_ongoing_requests: int | None = None,
+                max_queued_requests: int | None = None) -> "Deployment":
         return Deployment(
             self._target, name or self.name,
             num_replicas if num_replicas is not None
@@ -477,7 +460,9 @@ class Deployment:
             ray_actor_options if ray_actor_options is not None
             else self._actor_options,
             max_ongoing_requests if max_ongoing_requests is not None
-            else self._max_ongoing)
+            else self._max_ongoing,
+            max_queued_requests if max_queued_requests is not None
+            else self._max_queued)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -487,13 +472,14 @@ def deployment(target: type | Callable | None = None, *,
                name: str | None = None, num_replicas: int = 1,
                autoscaling_config: dict | None = None,
                ray_actor_options: dict | None = None,
-               max_ongoing_requests: int = 4):
+               max_ongoing_requests: int = 4,
+               max_queued_requests: int | None = None):
     """``@serve.deployment`` (bare or parameterized)."""
     def make(t):
         tgt = t if isinstance(t, type) else _wrap_function(t)
         return Deployment(tgt, name or t.__name__, num_replicas,
                           autoscaling_config, ray_actor_options,
-                          max_ongoing_requests)
+                          max_ongoing_requests, max_queued_requests)
     if target is not None:
         return make(target)
     return make
@@ -606,11 +592,14 @@ def run(app: Application, *, name: str = "default",
         b_args = tuple(_substitute_bound(x, build) for x in a.args)
         b_kwargs = {k: _substitute_bound(v, build)
                     for k, v in a.kwargs.items()}
+        from ray_tpu.common.config import get_config
+        max_queued = d._max_queued if d._max_queued is not None \
+            else get_config().serve_max_queued_requests
         controller_cls = ray_tpu.remote(_Controller)
         ctl = controller_cls.remote(
             serialize(d._target), serialize((b_args, b_kwargs)),
             d._num_replicas, d._autoscaling, d._actor_options,
-            d._max_ongoing)
+            d._max_ongoing, max_queued, d.name)
         # materialize the replica set before handing the handle out
         ray_tpu.get(ctl.num_replicas.remote(), timeout=60)
         h = DeploymentHandle(ctl)
@@ -674,17 +663,36 @@ def status(name: str = "default") -> dict:
         return {"status": "NOT_RUNNING"}
     n = ray_tpu.get(running.controller.num_replicas.remote(),
                     timeout=30)
-    return {"status": "RUNNING",
-            "deployment": running.deployment.name,
-            "num_replicas": n}
+    out = {"status": "RUNNING",
+           "deployment": running.deployment.name,
+           "num_replicas": n}
+    try:
+        plane = ray_tpu.get(running.controller.stats.remote(),
+                            timeout=30)
+        from .router import RequestRouter
+        plane.update(
+            RequestRouter.for_controller(running.controller)
+            .snapshot())
+        out["request_plane"] = plane
+    except Exception:   # noqa: BLE001 — status must answer regardless
+        pass
+    return out
 
 
 def _teardown(running: _Running) -> None:
     import ray_tpu
+
+    from .router import RequestRouter
     # root first (nothing routes into the children once it is gone),
-    # then the graph's children
+    # then the graph's children; each router is discarded BEFORE its
+    # controller dies so queued requests poison cleanly instead of
+    # dispatching into a dead replica set
     for ctl in [running.controller] + \
             list(reversed(running.child_controllers)):
+        try:
+            RequestRouter.discard(ctl)
+        except Exception:   # noqa: BLE001
+            pass
         try:
             ray_tpu.get(ctl.shutdown.remote(), timeout=30)
             ray_tpu.kill(ctl)

@@ -58,9 +58,10 @@ class HttpIngress(BackgroundHTTPServer):
         responses go out with chunked transfer encoding, one chunk per
         yielded item (reference: Serve streaming HTTP responses)."""
         prefix = _norm_prefix(prefix)
-        # the stream-mode handle is built ONCE here: a per-request
-        # options() would pay a controller refresh per request and
-        # discard the router's load view
+        # handle variants are cheap facades over one shared per-
+        # deployment RequestRouter, so building them here or per
+        # request makes no routing difference; the stream one is
+        # prebuilt simply because its mode is fixed per route
         stream_handle = handle.options(stream=True) if stream else None
         with self._rlock:
             self._routes[prefix] = (handle, stream_handle)
@@ -121,12 +122,34 @@ class HttpIngress(BackgroundHTTPServer):
         body = request.rfile.read(n) if n else b""
         req = HTTPRequest(method=request.command, path=path,
                           query=dict(parse_qsl(parts.query)), body=body)
+        # deadline propagation: X-Request-Deadline carries the client's
+        # remaining budget in seconds; the effective deadline (never
+        # looser than the ingress timeout) rides into the router, which
+        # drops the request BEFORE dispatch if it expires while queued
+        timeout = self._timeout
+        hdr = request.headers.get("X-Request-Deadline")
+        if hdr is not None:
+            try:
+                timeout = min(timeout, float(hdr))
+            except ValueError:
+                self.reply(request, json.dumps(
+                    {"error": "BadRequest",
+                     "message": "malformed X-Request-Deadline header"}
+                    ).encode(), "application/json", status=400)
+                return
+            if timeout <= 0:
+                self._reply_deadline(request, "deadline already expired")
+                return
         if stream_handle is not None:
-            gen = stream_handle.remote(req)
+            try:
+                gen = stream_handle.remote(req)
+            except Exception as e:      # noqa: BLE001
+                self._reply_error(request, e)
+                return
 
             def chunks():
                 for ref in gen:
-                    item = ray_tpu.get(ref, timeout=self._timeout)
+                    item = ray_tpu.get(ref, timeout=timeout)
                     if isinstance(item, (bytes, bytearray)):
                         yield bytes(item)
                     elif isinstance(item, str):
@@ -136,7 +159,13 @@ class HttpIngress(BackgroundHTTPServer):
             self.reply_stream(request, chunks(),
                               "application/octet-stream")
             return
-        result = ray_tpu.get(handle.remote(req), timeout=self._timeout)
+        try:
+            result = ray_tpu.get(
+                handle.options(timeout_s=timeout).remote(req),
+                timeout=timeout)
+        except Exception as e:          # noqa: BLE001
+            self._reply_error(request, e)
+            return
         if isinstance(result, (bytes, bytearray)):
             self.reply(request, bytes(result), "application/octet-stream")
         elif isinstance(result, str):
@@ -145,6 +174,32 @@ class HttpIngress(BackgroundHTTPServer):
         else:
             self.reply(request, json.dumps(result).encode(),
                        "application/json")
+
+    # -- error mapping -------------------------------------------------------
+    def _reply_error(self, request, exc: Exception) -> None:
+        """Structured error responses: a shed request answers 503 with a
+        Retry-After hint, a blown deadline answers 504, and a handler
+        exception answers 500 — never a dropped connection."""
+        from ..common.status import BackPressureError
+        if isinstance(exc, BackPressureError):
+            from ..common.config import get_config
+            retry_after = max(get_config().serve_retry_after_s, 0.0)
+            self.reply(request, json.dumps(
+                {"error": "BackPressure", "message": str(exc)}).encode(),
+                "application/json", status=503,
+                headers={"Retry-After": f"{retry_after:g}"})
+        elif isinstance(exc, TimeoutError):
+            self._reply_deadline(request, str(exc))
+        else:
+            self.reply(request, json.dumps(
+                {"error": type(exc).__name__,
+                 "message": str(exc)}).encode(),
+                "application/json", status=500)
+
+    def _reply_deadline(self, request, message: str) -> None:
+        self.reply(request, json.dumps(
+            {"error": "DeadlineExceeded", "message": message}).encode(),
+            "application/json", status=504)
 
     def _match(self, path: str):
         """Longest-prefix route match on path-segment boundaries;

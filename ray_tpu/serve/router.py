@@ -1,0 +1,586 @@
+"""Per-deployment request plane: admission control, bounded queueing,
+deadline-aware dispatch, load shedding, and request-level stats.
+
+Reference parity: upstream Serve's router
+(``python/ray/serve/_private/router.py``) sits between the
+``DeploymentHandle`` and the replica set — it caps per-replica in-flight
+requests at ``max_ongoing_requests`` (excess requests QUEUE client-side
+instead of over-submitting), bounds that queue at
+``max_queued_requests`` (a full queue sheds with ``BackPressureError``),
+and picks replicas with power-of-two-choices on observed load
+(SURVEY.md §1 layer 14; mount empty).
+
+Here the ``RequestRouter`` is process-global per controller (every
+handle facade for one deployment shares one router, so the load view
+and the queue are coherent), and queued requests are PROMISE object
+refs: ``remote()`` never blocks — when all replicas are saturated it
+allocates a fresh object id, parks the request in the bounded queue,
+and returns a ref to the not-yet-submitted result.  A dispatcher
+thread submits parked requests as completions free replica slots,
+copying each real result into its promise (or poisoning it on deadline
+expiry, so ``ray_tpu.get`` surfaces ``GetTimeoutError`` instead of
+hanging on work that was never done).
+
+Load accounting feeds the ``_Controller`` autoscaler through GCS KV:
+
+- ``inflight-<base>``  +1 at dispatch (router), -1 at completion
+  (replica shell) — or by the router itself when the completion is a
+  TRANSPORT error (dead replica): the shell never ran, so the router
+  must settle the counter or the backlog signal inflates forever.
+- ``queued-<base>``    +1 at enqueue, -1 at dispatch/expiry/shed.
+- ``lat-<base>``       request-latency EWMA (ms), written by the router
+  on every completion; the autoscaler and ``serve.status`` read it.
+- ``batch*-<base>``    batch-size histogram counters written by the
+  replicas' ``@serve.batch`` wrappers.
+
+Workers and replicas (no driver store, so completions are unobservable)
+fall back to direct dispatch with optimistic accounting — their KV
+increment still happens before submit and is rolled back if the submit
+itself fails.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..common.status import BackPressureError, GetTimeoutError
+from .batching import BATCH_BUCKETS
+
+
+def _api():
+    import ray_tpu
+    return ray_tpu
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+# -- stats -------------------------------------------------------------------
+
+_QPS_WINDOW_S = 5.0
+
+
+class _Stats:
+    """Driver-side request counters for one deployment (feeds the
+    Prometheus endpoint, the dashboard, and ``ray_tpu status``)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.user_errors = 0
+        self.transport_errors = 0
+        self.shed = 0
+        self.expired = 0
+        self.ewma_ms = 0.0
+        self._lat_ms = deque(maxlen=512)
+        self._done_t = deque(maxlen=4096)
+
+    def record_completion(self, lat_ms: float, alpha: float,
+                          user_error: bool) -> float:
+        with self.lock:
+            self.completed += 1
+            if user_error:
+                self.user_errors += 1
+            self._lat_ms.append(lat_ms)
+            self._done_t.append(time.monotonic())
+            self.ewma_ms = lat_ms if self.completed == 1 else \
+                alpha * lat_ms + (1.0 - alpha) * self.ewma_ms
+            return self.ewma_ms
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            lats = sorted(self._lat_ms)
+            now = time.monotonic()
+            recent = sum(1 for t in self._done_t
+                         if now - t <= _QPS_WINDOW_S)
+            out = {
+                "completed": self.completed,
+                "user_errors": self.user_errors,
+                "transport_errors": self.transport_errors,
+                "shed": self.shed,
+                "expired": self.expired,
+                "qps": round(recent / _QPS_WINDOW_S, 2),
+                "latency_ewma_ms": round(self.ewma_ms, 3),
+            }
+        if lats:
+            out["p50_ms"] = round(lats[len(lats) // 2], 3)
+            out["p99_ms"] = round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))], 3)
+        else:
+            out["p50_ms"] = out["p99_ms"] = 0.0
+        return out
+
+
+class _Queued:
+    __slots__ = ("method", "args", "kwargs", "mux", "deadline", "ref",
+                 "t_enq")
+
+    def __init__(self, method, args, kwargs, mux, deadline, ref):
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+        self.mux = mux
+        self.deadline = deadline    # monotonic, or None
+        self.ref = ref              # promise ObjectRef
+        self.t_enq = _now()
+
+
+# -- router ------------------------------------------------------------------
+
+class RequestRouter:
+    """One per deployment per process; see module docstring."""
+
+    _registry: dict[bytes, "RequestRouter"] = {}
+    _reg_lock = threading.Lock()
+
+    @classmethod
+    def for_controller(cls, controller) -> "RequestRouter":
+        key = controller._actor_id.binary()
+        with cls._reg_lock:
+            router = cls._registry.get(key)
+            if router is None:
+                router = cls._registry[key] = cls(controller)
+            return router
+
+    @classmethod
+    def discard(cls, controller) -> None:
+        key = controller._actor_id.binary()
+        with cls._reg_lock:
+            router = cls._registry.pop(key, None)
+        if router is not None:
+            router._close()
+
+    @classmethod
+    def _routers(cls) -> list["RequestRouter"]:
+        with cls._reg_lock:
+            return list(cls._registry.values())
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._cv = threading.Condition()
+        self._version = -1
+        self._replicas: list = []
+        self._kv_inflight = b""
+        self._kv_base = ""
+        self._cfg: dict = {}
+        self._inflight: dict[bytes, int] = {}
+        self._queue: deque[_Queued] = deque()
+        self._rr = 0
+        self._calls = 0
+        self._refreshing = False
+        self._closed = False
+        self._dispatcher: threading.Thread | None = None
+        self._stats = _Stats()
+        self._store = None
+        self._store_checked = False
+
+    # -- environment ---------------------------------------------------------
+    def _driver_store(self):
+        """The owner's memory store, or None outside the driver (workers
+        cannot observe completions, so they run in fallback mode)."""
+        if not self._store_checked:
+            try:
+                from ray_tpu.api import _get_runtime
+                self._store = getattr(_get_runtime(), "store", None)
+            except Exception:   # noqa: BLE001
+                self._store = None
+            self._store_checked = True
+        return self._store
+
+    def _kv(self, key: bytes, delta: int) -> None:
+        from ray_tpu.experimental.internal_kv import _internal_kv_incr
+        try:
+            _internal_kv_incr(key, delta, namespace="serve")
+        except Exception:   # noqa: BLE001 — accounting must not fail a call
+            pass
+
+    # -- replica view (satellite: fetch OUTSIDE the lock) --------------------
+    def _refresh(self, force: bool = False) -> None:
+        """Pick up controller-side scaling.  The RPC happens with no
+        router lock held — a slow controller must not stall concurrent
+        callers that already have a usable (if stale) view; only
+        view-LESS callers wait, on the fetching leader's result."""
+        with self._cv:
+            self._calls += 1
+            if not force and self._replicas and self._calls % 16 != 0:
+                return
+            while self._refreshing:
+                if self._replicas and not force:
+                    return          # stale view beats stalling
+                self._cv.wait(1.0)  # viewless: ride the leader's fetch
+                if self._replicas and not force:
+                    return
+                force = False       # the leader's result satisfies us
+            self._refreshing = True
+        got = None
+        try:
+            got = _api().get(self._controller.get_replicas.remote(),
+                             timeout=30)
+        finally:
+            with self._cv:
+                self._refreshing = False
+                if got is not None:
+                    version, replicas, kv_key, cfg = got
+                    if version != self._version:
+                        live = {r._actor_id.binary() for r in replicas}
+                        self._inflight = {
+                            k: v for k, v in self._inflight.items()
+                            if k in live}
+                    self._version, self._replicas = version, replicas
+                    self._kv_inflight = kv_key.encode()
+                    self._kv_base = cfg.get("base", "")
+                    self._cfg = cfg
+                self._cv.notify_all()
+
+    def _ensure_view(self) -> None:
+        self._refresh()
+        if not self._replicas:
+            # scale-to-zero cold start: ask for a replica, blocking
+            _api().get(self._controller.ensure_replica.remote(),
+                       timeout=60)
+            self._refresh(force=True)
+
+    # -- replica choice ------------------------------------------------------
+    def _load_locked(self, replica) -> int:
+        return self._inflight.get(replica._actor_id.binary(), 0)
+
+    def _pick_locked(self, mux: str, capped: bool = True):
+        """Power-of-two-choices among replicas with a free slot; a
+        multiplexed model id overrides with rendezvous hashing so one
+        model's calls stick to one replica (its ``@multiplexed`` LRU
+        stays hot) — a saturated sticky replica returns None (the
+        request queues rather than breaking stickiness)."""
+        import random
+        reps = self._replicas
+        if not reps:
+            return None
+        cap = self._cfg.get("max_ongoing", 4)
+        if mux and len(reps) > 1:
+            import hashlib
+            rep = max(reps, key=lambda r: hashlib.md5(
+                r._actor_id.binary() + mux.encode()).digest())
+            self._rr += 1
+            if capped and self._load_locked(rep) >= cap:
+                return None
+            return rep
+        elig = [r for r in reps
+                if not capped or self._load_locked(r) < cap]
+        if not elig:
+            return None
+        self._rr += 1
+        if len(elig) == 1:
+            return elig[0]
+        i, j = random.sample(range(len(elig)), 2)
+        li, lj = self._load_locked(elig[i]), self._load_locked(elig[j])
+        if li == lj:
+            return elig[(i, j)[self._rr % 2]]
+        return elig[i] if li < lj else elig[j]
+
+    def _acquire_locked(self, replica) -> None:
+        key = replica._actor_id.binary()
+        self._inflight[key] = self._inflight.get(key, 0) + 1
+
+    def _release(self, replica_key: bytes) -> None:
+        with self._cv:
+            c = self._inflight.get(replica_key, 0)
+            if c > 0:
+                self._inflight[replica_key] = c - 1
+            self._cv.notify_all()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, method: str, args: tuple, kwargs: dict, mux: str,
+               stream: bool, timeout_s: float | None):
+        self._ensure_view()
+        self._controller.tick.remote()  # fire-and-forget scale poke
+        if stream:
+            return self._submit_stream(method, args, kwargs, mux)
+        store = self._driver_store()
+        if store is None:
+            return self._submit_fallback(method, args, kwargs, mux)
+        deadline = None if timeout_s is None else _now() + timeout_s
+        if deadline is not None and timeout_s <= 0:
+            with self._stats.lock:
+                self._stats.expired += 1
+            raise GetTimeoutError(
+                f"request deadline expired before submission "
+                f"(timeout_s={timeout_s})")
+        with self._cv:
+            replica = self._pick_locked(mux)
+            if replica is not None:
+                self._acquire_locked(replica)
+            else:
+                return self._enqueue_locked(method, args, kwargs, mux,
+                                            deadline)
+        return self._dispatch(replica, method, args, kwargs, mux,
+                              promise=None)
+
+    def _enqueue_locked(self, method, args, kwargs, mux, deadline):
+        """All replicas saturated: park the request (bounded) and return
+        a promise ref.  Caller holds the router lock."""
+        from ray_tpu.common.ids import ObjectID
+        from ray_tpu.runtime.object_ref import ObjectRef
+        limit = self._cfg.get("max_queued", 200)
+        if len(self._queue) >= limit:
+            with self._stats.lock:
+                self._stats.shed += 1
+            name = self._cfg.get("name", "?")
+            raise BackPressureError(
+                f"deployment {name!r} rejected the request: all "
+                f"replicas are at max_ongoing_requests and the request "
+                f"queue is full ({limit} queued); retry later")
+        ref = ObjectRef(ObjectID.from_random())
+        item = _Queued(method, args, kwargs, mux, deadline, ref)
+        self._queue.append(item)
+        self._kv(b"queued-" + self._kv_base.encode(), 1)
+        if self._dispatcher is None or not self._dispatcher.is_alive():
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"serve-router-{self._cfg.get('name', '?')}")
+            self._dispatcher.start()
+        self._cv.notify_all()
+        return ref
+
+    def _submit_call(self, replica, method, args, kwargs, mux,
+                     streaming: bool = False):
+        """KV-accounted submit: +1 inflight BEFORE the call (backlog
+        drives upscaling), rolled back if the submit itself raises —
+        a failed submit must not permanently inflate the signal."""
+        from ray_tpu.actor_api import ActorMethod
+        self._kv(self._kv_inflight, 1)
+        try:
+            if streaming:
+                return ActorMethod(replica, "__serve_call__",
+                                   num_returns="streaming").remote(
+                    method, args, kwargs, mux)
+            return ActorMethod(replica, "__serve_call__").remote(
+                method, args, kwargs, mux)
+        except BaseException:
+            self._kv(self._kv_inflight, -1)
+            raise
+
+    def _dispatch(self, replica, method, args, kwargs, mux, promise):
+        """Submit to an acquired replica slot and watch the completion.
+        Returns the real ref (inline path) — queued requests get their
+        promise fulfilled instead."""
+        rkey = replica._actor_id.binary()
+        try:
+            ref = self._submit_call(replica, method, args, kwargs, mux)
+        except BaseException as err:
+            self._release(rkey)
+            if promise is None:
+                raise
+            self._poison(promise, err)
+            return None
+        self._watch(rkey, ref, promise)
+        return ref
+
+    def _watch(self, replica_key: bytes, ref, promise) -> None:
+        """Completion observer: frees the replica slot, classifies the
+        result (transport errors settle the shell's KV debt), records
+        latency, and fulfills the promise for queued requests."""
+        store = self._driver_store()
+        t0 = _now()
+
+        def done(_oid=None):
+            from ray_tpu.runtime.serialization import (ActorDiedError,
+                                                       TaskCancelledError,
+                                                       WorkerCrashedError)
+            lat_ms = (_now() - t0) * 1000.0
+            err = store.error_of(ref.id)
+            transport = err is not None and isinstance(
+                err.cause,
+                (ActorDiedError, WorkerCrashedError, TaskCancelledError))
+            if transport:
+                # the replica shell never ran: settle its -1 ourselves
+                self._kv(self._kv_inflight, -1)
+                with self._stats.lock:
+                    self._stats.transport_errors += 1
+            else:
+                from ray_tpu.common.config import get_config
+                alpha = get_config().serve_latency_ewma_alpha
+                ewma = self._stats.record_completion(
+                    lat_ms, alpha, user_error=err is not None)
+                self._write_latency(ewma)
+            self._release(replica_key)
+            if promise is not None:
+                self._fulfill(promise, ref)
+        store.on_ready(ref.id, done)
+
+    def _write_latency(self, ewma_ms: float) -> None:
+        from ray_tpu.experimental.internal_kv import _internal_kv_put
+        try:
+            _internal_kv_put(b"lat-" + self._kv_base.encode(),
+                             f"{ewma_ms:.3f}".encode(),
+                             namespace="serve")
+        except Exception:   # noqa: BLE001
+            pass
+
+    def _fulfill(self, promise, real_ref) -> None:
+        """Copy the settled real result into the promise entry.  Runs on
+        a store sealer thread: the common case (in-band or local value)
+        is a dict copy; the rare remote-resident case is handed to a
+        one-shot thread so the sealer never blocks on a pull."""
+        store = self._driver_store()
+        try:
+            vals = store.get_raw_blocking([real_ref.id], timeout=0.0)
+            if vals is None:
+                raise KeyError("result not present")
+            store.put(promise.id, vals[0])
+        except Exception:   # noqa: BLE001 — remote entry: pull off-thread
+            def pull():
+                try:
+                    store.put(promise.id,
+                              _api().get(real_ref, timeout=60))
+                except BaseException as err:    # noqa: BLE001
+                    self._poison(promise, err)
+            threading.Thread(target=pull, daemon=True,
+                             name="serve-promise-pull").start()
+
+    def _poison(self, promise, err: BaseException) -> None:
+        from ray_tpu.runtime.serialization import RayTaskError
+        store = self._driver_store()
+        if isinstance(err, RayTaskError):
+            store.poison(promise.id, err)
+        else:
+            store.poison(promise.id, RayTaskError(
+                "serve request", f"{type(err).__name__}: {err}", err))
+
+    # -- queued dispatch -----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            expired: list[_Queued] = []
+            to_send: list[tuple[_Queued, object]] = []
+            with self._cv:
+                if self._closed:
+                    return
+                now = _now()
+                remaining: deque[_Queued] = deque()
+                while self._queue:
+                    item = self._queue.popleft()
+                    if item.deadline is not None \
+                            and item.deadline <= now:
+                        expired.append(item)
+                        continue
+                    replica = self._pick_locked(item.mux)
+                    if replica is None:
+                        remaining.append(item)
+                        continue
+                    self._acquire_locked(replica)
+                    to_send.append((item, replica))
+                self._queue = remaining
+                if not expired and not to_send:
+                    wait = 0.5
+                    deadlines = [i.deadline for i in self._queue
+                                 if i.deadline is not None]
+                    if deadlines:
+                        wait = min(wait,
+                                   max(min(deadlines) - _now(), 0.0))
+                    self._cv.wait(wait)
+                    continue
+            qkey = b"queued-" + self._kv_base.encode()
+            for item in expired:
+                self._kv(qkey, -1)
+                with self._stats.lock:
+                    self._stats.expired += 1
+                self._poison(item.ref, GetTimeoutError(
+                    f"request expired after "
+                    f"{_now() - item.t_enq:.3f}s in the "
+                    f"{self._cfg.get('name', '?')!r} queue, before "
+                    "dispatch"))
+            for item, replica in to_send:
+                self._kv(qkey, -1)
+                self._dispatch(replica, item.method, item.args,
+                               item.kwargs, item.mux, promise=item.ref)
+
+    # -- non-driver / streaming paths ----------------------------------------
+    def _submit_fallback(self, method, args, kwargs, mux):
+        """Worker-side handles cannot observe completions: dispatch
+        directly (uncapped) with round-robin-ish p2c."""
+        with self._cv:
+            replica = self._pick_locked(mux, capped=False)
+        if replica is None:
+            raise RuntimeError("no replicas available")
+        return self._submit_call(replica, method, args, kwargs, mux)
+
+    def _submit_stream(self, method, args, kwargs, mux):
+        """Streaming calls bypass the queue and the in-flight cap:
+        there is no single seal to observe, and a long-lived stream
+        pinning a slot would starve unary traffic.  The KV inflight
+        count still covers them (the shell settles at stream end)."""
+        with self._cv:
+            replica = self._pick_locked(mux, capped=False)
+        if replica is None:
+            raise RuntimeError("no replicas available")
+        return self._submit_call(replica, method, args, kwargs, mux,
+                                 streaming=True)
+
+    # -- teardown / introspection -------------------------------------------
+    def _close(self) -> None:
+        with self._cv:
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        if self._driver_store() is not None:
+            for item in pending:
+                self._poison(item.ref, GetTimeoutError(
+                    "deployment deleted while the request was queued"))
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            out = {
+                "deployment": self._cfg.get("name", ""),
+                "replicas": len(self._replicas),
+                "queued": len(self._queue),
+                "inflight": sum(self._inflight.values()),
+                "max_ongoing_requests": self._cfg.get("max_ongoing", 0),
+                "max_queued_requests": self._cfg.get("max_queued", 0),
+            }
+        out.update(self._stats.snapshot())
+        out.update(batch_stats(self._kv_base))
+        return out
+
+
+def batch_stats(kv_base: str) -> dict:
+    """Aggregate the replicas' batch-size KV counters for one
+    deployment: count, mean, and the raw (non-cumulative) buckets."""
+    if not kv_base:
+        return {}
+    from ray_tpu.experimental.internal_kv import _internal_kv_incr
+    try:
+        cnt = _internal_kv_incr(f"batchcnt-{kv_base}".encode(), 0,
+                                namespace="serve")
+        if not cnt:
+            return {}
+        total = _internal_kv_incr(f"batchsum-{kv_base}".encode(), 0,
+                                  namespace="serve")
+        buckets = {}
+        for le in list(BATCH_BUCKETS) + ["inf"]:
+            n = _internal_kv_incr(f"batchb-{le}-{kv_base}".encode(), 0,
+                                  namespace="serve")
+            if n:
+                buckets[str(le)] = n
+        return {"batches": cnt,
+                "batch_size_mean": round(total / cnt, 2),
+                "batch_size_buckets": buckets}
+    except Exception:   # noqa: BLE001
+        return {}
+
+
+def request_plane_stats() -> dict[str, dict]:
+    """Per-deployment request-plane stats for every router in this
+    process, keyed by deployment name (metrics/dashboard/status hook)."""
+    out: dict[str, dict] = {}
+    for router in RequestRouter._routers():
+        try:
+            snap = router.snapshot()
+        except Exception:   # noqa: BLE001
+            continue
+        name = snap.get("deployment") or "?"
+        if name in out:
+            name = f"{name}@{router._kv_base[:4]}"
+        out[name] = snap
+    return out

@@ -12,6 +12,8 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 
+pytestmark = pytest.mark.serve
+
 
 @pytest.fixture(scope="module", autouse=True)
 def driver():
@@ -268,15 +270,26 @@ class TestHttpIngress:
         yield
         serve.shutdown()
 
-    def _get(self, url, data=None, method=None):
+    def _get(self, url, data=None, method=None, headers=None):
         import json as _json
         import urllib.request
-        req = urllib.request.Request(url, data=data, method=method)
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
                 return r.status, r.headers["Content-Type"], r.read()
         except urllib.error.HTTPError as e:
             return e.code, e.headers.get("Content-Type", ""), e.read()
+
+    def _get_full(self, url, headers=None):
+        """Like _get but keeps ALL response headers (Retry-After)."""
+        import urllib.request
+        req = urllib.request.Request(url, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
 
     def test_json_roundtrip_and_routing(self):
         import json as _json
@@ -418,6 +431,97 @@ class TestHttpIngress:
             reply = s.recv(4096)
         assert b"413" in reply.split(b"\r\n", 1)[0]
 
+    def test_handler_timeout_maps_to_504(self):
+        import json as _json
+
+        @serve.deployment
+        class Glacial:
+            def __call__(self, request):
+                time.sleep(5)
+                return "too late"
+
+        serve.run(Glacial.bind(), route_prefix="/slow")
+        base = serve.http_address()
+        t0 = time.monotonic()
+        status, _, body = self._get(
+            f"{base}/slow", headers={"X-Request-Deadline": "0.3"})
+        dt = time.monotonic() - t0
+        assert status == 504
+        err = _json.loads(body)
+        assert err["error"] == "DeadlineExceeded"
+        assert dt < 4.0, f"504 waited for the handler ({dt:.1f}s)"
+
+    def test_malformed_deadline_header_rejected(self):
+        import json as _json
+
+        @serve.deployment
+        class Fine:
+            def __call__(self, request):
+                return "ok"
+
+        serve.run(Fine.bind(), route_prefix="/f")
+        base = serve.http_address()
+        status, _, body = self._get(
+            f"{base}/f", headers={"X-Request-Deadline": "soon"})
+        assert status == 400
+        assert "X-Request-Deadline" in _json.loads(body)["message"]
+        # an already-expired budget never reaches the handler either
+        status, _, body = self._get(
+            f"{base}/f", headers={"X-Request-Deadline": "0"})
+        assert status == 504
+
+    def test_malformed_content_length_rejected(self):
+        import socket
+
+        @serve.deployment
+        class Sink:
+            def __call__(self, request):
+                return "ok"
+
+        serve.run(Sink.bind(), route_prefix="/sink")
+        base = serve.http_address()
+        host, port = base.removeprefix("http://").rsplit(":", 1)
+        with socket.create_connection((host, int(port)),
+                                      timeout=30) as s:
+            s.sendall(b"POST /sink HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: abc\r\n\r\n")
+            reply = s.recv(4096)
+        assert b"400" in reply.split(b"\r\n", 1)[0]
+
+    def test_overload_sheds_503_with_retry_after(self):
+        """At sustained overload the ingress must SHED (503 +
+        Retry-After) instead of queueing without bound."""
+        import json as _json
+        import threading
+
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                          max_queued_requests=1)
+        class Busy:
+            def __call__(self, request):
+                time.sleep(0.6)
+                return "served"
+
+        serve.run(Busy.bind(), route_prefix="/busy")
+        base = serve.http_address()
+        results = []
+
+        def hit():
+            results.append(self._get_full(f"{base}/busy"))
+
+        threads = [threading.Thread(target=hit) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        shed = [r for r in results if r[0] == 503]
+        ok = [r for r in results if r[0] == 200]
+        assert shed, f"nothing shed: {[r[0] for r in results]}"
+        assert ok, f"nothing served: {[r[0] for r in results]}"
+        for status, headers, body in shed:
+            assert float(headers["Retry-After"]) > 0
+            assert _json.loads(body)["error"] == "BackPressure"
+        assert ok[0][2] == b"served"
+
     def test_read_only_surfaces_refuse_mutating_verbs(self):
         from ray_tpu.api import _get_runtime
         from ray_tpu.runtime.dashboard import Dashboard
@@ -476,5 +580,36 @@ class TestModelMultiplexing:
                     loads_by_replica.get(rep, 0), n_loads)
             # every load was counted; total loads >= distinct ids
             assert sum(loads_by_replica.values()) >= 3
+        finally:
+            serve.delete("mux")
+
+    def test_mux_stickiness_survives_replica_set_refresh(self):
+        """A forced router refresh of an unchanged replica set must not
+        move a model's traffic: rendezvous hashing is deterministic, so
+        stickiness (and the replica's model cache) survives."""
+        from ray_tpu.serve.router import RequestRouter
+
+        @serve.deployment(num_replicas=2)
+        class Sticky:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                return f"model:{model_id}"
+
+            def __call__(self, x):
+                mid = serve.get_multiplexed_model_id()
+                return self.get_model(mid), id(self)
+
+        handle = serve.run(Sticky.bind(), name="mux")
+        try:
+            h = handle.options(multiplexed_model_id="m-pin")
+            router = RequestRouter.for_controller(handle._controller)
+            replicas = set()
+            for i in range(6):
+                model, rep = ray_tpu.get(h.remote(i), timeout=60)
+                assert model == "model:m-pin"
+                replicas.add(rep)
+                router._refresh(force=True)     # re-fetch the view
+            assert len(replicas) == 1, \
+                f"refresh moved the model across {len(replicas)} replicas"
         finally:
             serve.delete("mux")

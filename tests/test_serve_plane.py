@@ -1,0 +1,382 @@
+"""Serve request plane: micro-batching, admission control, deadlines.
+
+Scenario sources: upstream ``ray.serve`` request-path contract —
+``@serve.batch`` dynamic batching, ``max_ongoing_requests`` capping
+in-flight work per replica (excess requests queue client-side),
+``max_queued_requests`` shedding with ``BackPressureError``, and
+queue-depth-driven autoscaling (SURVEY.md §1 layer 14; scenarios
+re-derived, not copied)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.common.status import BackPressureError
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def driver():
+    ray_tpu.init(resources={"CPU": 12, "memory": 8}, num_workers=6)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def cleanup():
+    yield
+    serve.delete("default")
+
+
+def _plane_status() -> dict:
+    return serve.status().get("request_plane", {})
+
+
+class TestBatcherUnit:
+    """The @serve.batch wrapper, driven directly by threads (no
+    cluster): coalescing, the size cap, and handler-contract errors."""
+
+    def _fanout(self, fn, inputs):
+        out, errs = {}, {}
+
+        def call(i, x):
+            try:
+                out[i] = fn(x)
+            except Exception as e:      # noqa: BLE001
+                errs[i] = e
+        threads = [threading.Thread(target=call, args=(i, x))
+                   for i, x in enumerate(inputs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out, errs
+
+    def test_coalesces_and_respects_size_cap(self):
+        sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def double(items):
+            sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        out, errs = self._fanout(double, list(range(10)))
+        assert not errs
+        assert out == {i: 2 * i for i in range(10)}
+        assert max(sizes) <= 4
+        assert max(sizes) >= 2, "no coalescing happened"
+
+    def test_handler_error_propagates_to_every_member(self):
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+        def boom(items):
+            raise RuntimeError("nope")
+
+        out, errs = self._fanout(boom, list(range(3)))
+        assert not out and len(errs) == 3
+        assert all("nope" in str(e) for e in errs.values())
+
+    def test_per_item_exception_results(self):
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+        def mixed(items):
+            return [KeyError("bad") if v == 1 else v for v in items]
+
+        out, errs = self._fanout(mixed, [0, 1, 2])
+        assert out == {0: 0, 2: 2}
+        assert isinstance(errs[1], KeyError)
+
+    def test_length_mismatch_is_an_error(self):
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.0)
+        def short(items):
+            return items[:-1] if len(items) > 1 else []
+
+        out, errs = self._fanout(short, [7])
+        assert not out and "must return a list" in str(errs[0])
+
+
+class TestBatchingInReplica:
+    def test_concurrent_calls_coalesce(self):
+        @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+        class Batched:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+            def __call__(self, items):
+                # each caller learns how big its batch was
+                return [len(items)] * len(items)
+
+        handle = serve.run(Batched.bind())
+        got = ray_tpu.get([handle.remote(i) for i in range(8)],
+                          timeout=60)
+        assert max(got) >= 2, f"no coalescing: batch sizes {got}"
+        # the KV batch histogram surfaced through serve.status
+        plane = _plane_status()
+        assert plane.get("batches", 0) >= 1
+        assert plane.get("batch_size_mean", 0) >= 1
+
+    def test_early_cut_beats_the_window_timeout(self):
+        """With every in-flight call already in the batch, the leader
+        must ship WITHOUT waiting out a long batch window."""
+        @serve.deployment(num_replicas=1, max_ongoing_requests=4)
+        class Patient:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=5.0)
+            def __call__(self, items):
+                return [len(items)] * len(items)
+
+        handle = serve.run(Patient.bind())
+        t0 = time.monotonic()
+        got = ray_tpu.get([handle.remote(i) for i in range(2)],
+                          timeout=60)
+        dt = time.monotonic() - t0
+        assert sorted(set(got)) in ([1], [1, 2], [2])
+        assert dt < 3.0, f"batch window was not cut early ({dt:.1f}s)"
+
+
+class TestAdmissionControl:
+    def test_inflight_cap_limits_replica_concurrency(self):
+        @serve.deployment(num_replicas=1, max_ongoing_requests=2)
+        class Gauge:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.live = 0
+                self.peak = 0
+
+            def __call__(self, dt):
+                with self.lock:
+                    self.live += 1
+                    self.peak = max(self.peak, self.live)
+                time.sleep(dt)
+                with self.lock:
+                    self.live -= 1
+                return "ok"
+
+            def peak_seen(self):
+                return self.peak
+
+        handle = serve.run(Gauge.bind())
+        refs = [handle.remote(0.15) for _ in range(6)]
+        # the router (not the replica) is what holds the excess back:
+        # its queue must actually be exercised while the slots are full
+        saw_queued = 0
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            plane = _plane_status()
+            saw_queued = max(saw_queued, plane.get("queued", 0))
+            assert plane.get("inflight", 0) <= 2
+            if saw_queued and plane.get("queued", 0) == 0:
+                break
+            time.sleep(0.02)
+        assert saw_queued >= 1, "router never parked the overflow"
+        out = ray_tpu.get(refs, timeout=60)
+        assert out == ["ok"] * 6
+        peak = ray_tpu.get(
+            handle.options(method_name="peak_seen").remote(),
+            timeout=30)
+        assert peak <= 2, f"router over-submitted: {peak} concurrent"
+
+    def test_full_queue_sheds_with_backpressure(self):
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                          max_queued_requests=2)
+        class Slow:
+            def __call__(self):
+                time.sleep(0.8)
+                return "done"
+
+        handle = serve.run(Slow.bind())
+        refs = [handle.remote() for _ in range(3)]   # 1 running + 2 queued
+        with pytest.raises(BackPressureError, match="queue is full"):
+            for _ in range(8):
+                refs.append(handle.remote())
+        shed_before = _plane_status().get("shed", 0)
+        assert shed_before >= 1
+        # the accepted requests still complete — shedding is selective
+        assert ray_tpu.get(refs, timeout=60) == ["done"] * len(refs)
+
+    def test_queued_results_and_errors_resolve_through_promises(self):
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1)
+        class Picky:
+            def __call__(self, x):
+                time.sleep(0.05)
+                if x % 3 == 0:
+                    raise ValueError(f"rejected {x}")
+                return x * 10
+
+        handle = serve.run(Picky.bind())
+        refs = [handle.remote(x) for x in range(7)]
+        for x, ref in enumerate(refs):
+            if x % 3 == 0:
+                with pytest.raises(ValueError, match=f"rejected {x}"):
+                    ray_tpu.get(ref, timeout=60)
+            else:
+                assert ray_tpu.get(ref, timeout=60) == x * 10
+
+
+class TestDeadlines:
+    def test_queued_request_expires_before_dispatch(self):
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1)
+        class Wedge:
+            def __call__(self, dt):
+                time.sleep(dt)
+                return "ok"
+
+        handle = serve.run(Wedge.bind())
+        wedge = handle.remote(2.5)          # occupies the only slot
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        doomed = handle.options(timeout_s=0.2).remote(0.0)
+        with pytest.raises(TimeoutError, match="expired"):
+            ray_tpu.get(doomed, timeout=10)
+        dt = time.monotonic() - t0
+        assert dt < 2.0, f"expiry waited for the wedge ({dt:.1f}s)"
+        assert _plane_status().get("expired", 0) >= 1
+        assert ray_tpu.get(wedge, timeout=60) == "ok"
+
+    def test_already_expired_deadline_fails_fast(self):
+        @serve.deployment
+        class Quick:
+            def __call__(self):
+                return "ok"
+
+        handle = serve.run(Quick.bind())
+        with pytest.raises(TimeoutError):
+            handle.options(timeout_s=0).remote()
+
+
+class TestKvAccounting:
+    def _kv_inflight(self) -> int:
+        # the controller reads the raw KV counter (the autoscaler's
+        # signal) — the router snapshot would mask it with its local
+        # in-flight view
+        ctl = serve.get_deployment_handle()._controller
+        return ray_tpu.get(ctl.stats.remote(), timeout=30)["inflight"]
+
+    def test_failed_submit_rolls_back_the_backlog_signal(self):
+        """A submit that raises must decrement the KV counter it
+        optimistically incremented — otherwise the autoscaler sees a
+        phantom backlog forever."""
+        import ray_tpu.actor_api as actor_api
+
+        @serve.deployment
+        class Fine:
+            def __call__(self):
+                return "ok"
+
+        handle = serve.run(Fine.bind())
+        assert ray_tpu.get(handle.remote(), timeout=60) == "ok"
+
+        real = actor_api.ActorMethod
+
+        class Exploding(real):
+            def remote(self, *a, **k):
+                # only the replica dispatch fails — control-plane RPCs
+                # (tick, get_replicas) keep working
+                if self._name == "__serve_call__":
+                    raise RuntimeError("injected submit failure")
+                return super().remote(*a, **k)
+
+        actor_api.ActorMethod = Exploding
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                handle.remote()
+        finally:
+            actor_api.ActorMethod = real
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if self._kv_inflight() == 0:
+                break
+            time.sleep(0.05)
+        assert self._kv_inflight() == 0, "failed submit leaked inflight"
+        # and the deployment still serves
+        assert ray_tpu.get(handle.remote(), timeout=60) == "ok"
+
+    def test_dead_replica_completion_settles_inflight(self):
+        """A call that dies in transport (replica killed) never runs
+        the shell's decrement — the router must settle it."""
+        @serve.deployment(num_replicas=1)
+        class Mortal:
+            def __call__(self):
+                return "alive"
+
+        handle = serve.run(Mortal.bind())
+        assert ray_tpu.get(handle.remote(), timeout=60) == "alive"
+        running = serve.get_deployment_handle()
+        _, replicas, _, _ = ray_tpu.get(
+            running._controller.get_replicas.remote(), timeout=30)
+        ray_tpu.kill(replicas[0])
+        time.sleep(0.2)
+        with pytest.raises(Exception):
+            ray_tpu.get(handle.remote(), timeout=30)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if self._kv_inflight() == 0:
+                break
+            time.sleep(0.05)
+        assert self._kv_inflight() == 0
+        assert _plane_status().get("transport_errors", 0) >= 1
+
+
+class TestAutoscaleSignals:
+    def test_queue_depth_drives_upscale(self):
+        """With max_ongoing_requests=1 the raw inflight counter can
+        never exceed the replica count — only the QUEUE DEPTH signal
+        can justify adding replicas."""
+        @serve.deployment(max_ongoing_requests=1, autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 1,
+            "upscale_delay_s": 0.0, "downscale_delay_s": 5.0})
+        class Busy:
+            def __call__(self):
+                time.sleep(0.3)
+                return "done"
+
+        handle = serve.run(Busy.bind())
+        assert serve.status()["num_replicas"] == 1
+        refs = [handle.remote() for _ in range(6)]
+        peak = 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            peak = max(peak, serve.status()["num_replicas"])
+            if peak >= 2:
+                break
+            time.sleep(0.05)
+        assert peak >= 2, "queued backlog never drove an upscale"
+        assert ray_tpu.get(refs, timeout=60) == ["done"] * 6
+
+    def test_latency_ewma_reaches_the_controller(self):
+        @serve.deployment
+        class Timed:
+            def __call__(self):
+                time.sleep(0.05)
+                return "ok"
+
+        handle = serve.run(Timed.bind())
+        ray_tpu.get([handle.remote() for _ in range(4)], timeout=60)
+        deadline = time.monotonic() + 5
+        lat = 0.0
+        while time.monotonic() < deadline:
+            lat = _plane_status().get("latency_ewma_ms", 0.0)
+            if lat >= 40.0:
+                break
+            time.sleep(0.05)
+        assert lat >= 40.0, f"latency EWMA never propagated ({lat}ms)"
+
+
+class TestObservability:
+    def test_request_plane_stats_in_metrics_text(self):
+        from ray_tpu.api import _get_runtime
+        from ray_tpu.runtime.metrics import render_metrics
+
+        @serve.deployment
+        class Obs:
+            def __call__(self):
+                return "ok"
+
+        handle = serve.run(Obs.bind())
+        ray_tpu.get([handle.remote() for _ in range(3)], timeout=60)
+        text = render_metrics(_get_runtime().cluster)
+        assert 'ray_tpu_serve_qps{deployment="Obs"}' in text
+        assert 'ray_tpu_serve_completed_requests_total' in text
+        plane = _plane_status()
+        assert plane.get("completed", 0) >= 3
+        assert plane["deployment"] == "Obs"
