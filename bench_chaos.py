@@ -1,0 +1,136 @@
+"""Gray-fabric benchmark: the cost of chaos, and the proof it converges.
+
+Runs the two data paths clean and under a seeded gray fabric
+(``rpc/chaos.py``: 5% frame drop + 50 ms jitter on 5% of messages,
+seed 42) and prints exactly one JSON line:
+
+- **task plane**: sequential retryable RPC round-trips — drops are
+  absorbed by the idempotent-retry budget (backoff + full jitter), so
+  the acceptance bar is ZERO lost calls; the number is the completion
+  rate you pay for a lossy control fabric.
+- **object plane**: a 64 MB arena-to-arena pull under the same jitter.
+  The bulk-chunk link is scoped jitter-only (``links=`` override): the
+  plane's failover model for a lossy peer is source death / breaker
+  quarantine, so an injected *frame* loss there would measure the
+  60 s chunk-timeout constant, not the data path.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+CALLS = 200
+SIZE_MB = 64
+ARENA_MB = 128
+CHAOS = {"seed": 42, "drop_p": 0.05, "delay_p": 0.05, "delay_ms": 50.0}
+
+
+class _Endpoint:
+    def __init__(self, tmp, name):
+        from ray_tpu.native import Arena
+        from ray_tpu.rpc import RpcServer
+        from ray_tpu.runtime.object_plane import ObjectPlane
+        from ray_tpu.runtime.object_store import MemoryStore
+        self.arena = Arena(os.path.join(tmp, f"arena_{name}"),
+                           ARENA_MB << 20, create=True)
+        self.store = MemoryStore(
+            arena=self.arena, spill_dir=os.path.join(tmp, f"sp_{name}"))
+        self.plane = ObjectPlane(self.store)
+        self.server = RpcServer({}).start()
+        self.plane.attach(self.server)
+
+    def stop(self):
+        self.plane.shutdown()
+        self.server.stop()
+
+
+def _task_rate(chaos_on: bool):
+    """Sequential retryable echo round-trips; (calls/s, lost)."""
+    from ray_tpu.common.config import Config
+    from ray_tpu.rpc import RpcClient, RpcServer, chaos
+    Config.reset({"rpc_retry_max_attempts": 6,
+                  "rpc_retry_base_ms": 5.0,
+                  "rpc_retry_max_ms": 50.0})
+    srv = RpcServer({"echo": lambda x: x}).start()
+    client = RpcClient(srv.address, timeout=5.0,
+                       retryable=frozenset({"echo"}))
+    try:
+        if chaos_on:
+            chaos.configure(**CHAOS)
+        lost = 0
+        t0 = time.perf_counter()
+        for i in range(CALLS):
+            try:
+                assert client.call("echo", i, timeout=0.25) == i
+            except (TimeoutError, ConnectionError):
+                lost += 1
+        dt = time.perf_counter() - t0
+        return CALLS / dt, lost
+    finally:
+        chaos.disable()
+        client.close()
+        srv.stop()
+
+
+def _pull_rate(tmp, tag, chaos_on: bool):
+    """Best-of-3 single-source pull throughput in MB/s."""
+    from ray_tpu.common.config import Config
+    from ray_tpu.common.ids import ObjectID
+    from ray_tpu.rpc import chaos
+    from ray_tpu.runtime.serialization import serialize
+    Config.reset({"object_transfer_chunk_mb": 1})
+    payload = os.urandom(1 << 20) * SIZE_MB
+    oid = ObjectID.from_random()
+    src, dest = _Endpoint(tmp, f"{tag}_src"), _Endpoint(tmp, f"{tag}_dest")
+    try:
+        src.store.put_serialized(oid, serialize(payload))
+        kind, size = src.store.plasma_info(oid)
+        assert kind == "shm" and size >= SIZE_MB << 20, (kind, size)
+        del payload
+        if chaos_on:
+            chaos.configure(**CHAOS, links={
+                src.server.address: {"drop_p": 0.0, "delay_p": 0.05,
+                                     "delay_ms": 50.0}})
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ok = dest.plane.pull_into_local(oid, size, src.server.address)
+            dt = time.perf_counter() - t0
+            assert ok, f"{tag}: pull failed"
+            best = max(best, (size / (1 << 20)) / dt)
+            dest.store.delete([oid])
+        return best
+    finally:
+        chaos.disable()
+        src.stop()
+        dest.stop()
+
+
+def main():
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="bench_chaos_", dir=shm)
+    try:
+        t_clean, lost_clean = _task_rate(False)
+        t_chaos, lost_chaos = _task_rate(True)
+        p_clean = _pull_rate(tmp, "clean", False)
+        p_chaos = _pull_rate(tmp, "gray", True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    ok = lost_clean == 0 and lost_chaos == 0
+    print(json.dumps({
+        "metric": f"gray fabric (5% drop + 50ms jitter, seed 42): "
+                  f"tasks {t_chaos:.0f}/s vs {t_clean:.0f}/s clean "
+                  f"(lost {lost_chaos}) | 64MB pull {p_chaos:.0f} vs "
+                  f"{p_clean:.0f} MB/s clean"
+                  + ("" if ok else " [LOST CALLS]"),
+        "value": round(t_chaos, 1),
+        "unit": "calls/s",
+        "vs_baseline": round(t_chaos / t_clean, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

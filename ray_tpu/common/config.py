@@ -187,6 +187,52 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "health_check_period_ms": (int, 1000, "GCS -> raylet ping period."),
     "health_check_failure_threshold": (
         int, 5, "Missed pings before a node is declared dead."),
+    # -- rpc gray-failure hardening -----------------------------------------
+    "rpc_retry_max_attempts": (
+        int, 3,
+        "Attempts (1 = no retry) for RPC methods a client marked "
+        "retryable; idempotent reads/stats only — mutations never "
+        "retry."),
+    "rpc_retry_base_ms": (
+        float, 50.0,
+        "Base backoff for retryable RPCs; attempt i sleeps "
+        "uniform(0, min(rpc_retry_max_ms, base * 2^i)) — exponential "
+        "backoff with full jitter."),
+    "rpc_retry_max_ms": (
+        float, 2000.0, "Backoff ceiling for retryable RPCs."),
+    "rpc_breaker_failure_threshold": (
+        int, 5,
+        "Consecutive call failures (timeout/connection loss) that open "
+        "a peer's circuit breaker."),
+    "rpc_breaker_reset_s": (
+        float, 5.0,
+        "Cooldown before an open breaker admits a half-open probe."),
+    "plane_source_blacklist_failures": (
+        int, 3,
+        "Transfer failures within the window that blacklist an object-"
+        "plane source address from striping/source selection."),
+    "plane_source_blacklist_s": (
+        float, 30.0,
+        "How long a blacklisted source stays excluded (it is still "
+        "used when it is the ONLY replica)."),
+    # -- network chaos plane (deterministic fault injection) ----------------
+    "chaos_enabled": (
+        bool, False,
+        "Arm the seeded network-chaos plane at first RPC endpoint "
+        "creation (rpc/chaos.py); every knob below is scoped by it."),
+    "chaos_seed": (
+        int, 0,
+        "Philox seed for per-link fault streams: the same seed replays "
+        "the exact injected-fault trace."),
+    "chaos_drop_p": (float, 0.0, "Per-message drop probability."),
+    "chaos_dup_p": (float, 0.0, "Per-message duplicate probability."),
+    "chaos_delay_p": (float, 0.0, "Per-message delay probability."),
+    "chaos_delay_ms": (
+        float, 0.0,
+        "Delay magnitude: a delayed message sleeps delay_ms*(0.5+u)."),
+    "chaos_bandwidth_mbps": (
+        float, 0.0,
+        "Per-connection bandwidth cap in Mbit/s (0 = uncapped)."),
     "lineage_pinning_memory_mb": (
         int, 256,
         "Budget for pinned task specs kept for lineage reconstruction."),

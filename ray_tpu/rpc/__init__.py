@@ -15,9 +15,11 @@ the driver<->head boundary (client mode, job submission) the way the
 reference's gRPC carries daemon-to-daemon traffic.
 """
 
+from .breaker import CircuitOpenError
 from .client import RemoteRpcError, RpcClient, RpcConnectionError, RpcFuture
 from .server import RpcServer
 from .wire import RawReply, RawResult
 
 __all__ = ["RpcServer", "RpcClient", "RpcConnectionError",
-           "RemoteRpcError", "RpcFuture", "RawReply", "RawResult"]
+           "RemoteRpcError", "RpcFuture", "RawReply", "RawResult",
+           "CircuitOpenError"]

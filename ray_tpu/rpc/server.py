@@ -21,6 +21,7 @@ import socket
 import threading
 import traceback
 
+from . import chaos as _chaos
 from .wire import (RawResult, recv_raw_frame, send_raw_frame,
                    send_raw_reply)
 
@@ -44,6 +45,7 @@ class RpcServer:
         # how the head ties client-session state to connection lifetime
         self._conn_cleanups: dict = {}
         self._tls = threading.local()
+        _chaos.ensure_env_init()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -186,6 +188,11 @@ class RpcServer:
             ok, payload = False, self._error_payload(e)
         finally:
             self._tls.conn = None
+        # chaos: the reply leg at the SERVER (link ``srv:<self>``) — a
+        # "drop" here models the asymmetric gray failure where requests
+        # arrive and execute but the answers vanish on the way back
+        ch = _chaos._active
+        act = ch.reply_action(self.address) if ch is not None else None
         if ok and isinstance(payload, RawResult):
             # data channel: the payload buffer (shm view / spill bytes)
             # is gather-written verbatim — no pickle, no concat copy.
@@ -194,10 +201,14 @@ class RpcServer:
             from ..runtime.serialization import serialize
             try:
                 meta_bytes = serialize(payload.meta)
-                with wlock:
-                    n = send_raw_reply(conn, req_id, meta_bytes,
-                                       payload.payload)
-                self._account(method, 0, n)
+                if act != "drop":
+                    with wlock:
+                        n = send_raw_reply(conn, req_id, meta_bytes,
+                                           payload.payload)
+                        if act == "dup":
+                            send_raw_reply(conn, req_id, meta_bytes,
+                                           payload.payload)
+                    self._account(method, 0, n)
             except (OSError, ConnectionError):
                 pass            # client went away; nothing to tell it
             finally:
@@ -213,10 +224,14 @@ class RpcServer:
             ok = False
             data = self._encode_reply(req_id, False,
                                       self._error_payload(e))
+        if act == "drop":
+            return              # reply lost on the (simulated) fabric
         self._account(method, 0, len(data))
         try:
             with wlock:
                 send_raw_frame(conn, data)
+                if act == "dup":
+                    send_raw_frame(conn, data)
         except (OSError, ConnectionError):
             pass                # client went away; nothing to tell it
 

@@ -44,6 +44,11 @@ _RAW_HDR_REST = struct.Struct(">QBI")
 # few bytes)
 _SMALL_FRAME = 1 << 16
 
+# chaos seam: ``rpc/chaos.py`` installs a per-connection bandwidth pacer
+# (callable(sock, nbytes)) here when a cap is configured; None (the
+# default) keeps the send path at a single global load + is-None test
+_chaos_pacer = None
+
 
 class RawResult:
     """Marker a handler returns to reply over the raw data channel:
@@ -96,6 +101,8 @@ def sendmsg_all(sock: socket.socket, buffers) -> None:
 def send_raw_frame(sock: socket.socket, data) -> None:
     """``data`` may be bytes, bytearray, or memoryview."""
     n = data.nbytes if isinstance(data, memoryview) else len(data)
+    if _chaos_pacer is not None:
+        _chaos_pacer(sock, n)
     if n > _SMALL_FRAME:
         # large frame: gather-write header+payload in one syscall,
         # zero-copy from the caller's buffer
@@ -113,6 +120,8 @@ def send_raw_reply(sock: socket.socket, req_id: int, meta_bytes: bytes,
     hdr = _RAW_HDR.pack(RAW_MARKER, req_id, 1 if ok else 0,
                         len(meta_bytes))
     n = len(hdr) + len(meta_bytes) + payload.nbytes
+    if _chaos_pacer is not None:
+        _chaos_pacer(sock, n)
     sendmsg_all(sock, [_LEN.pack(n), hdr, meta_bytes, payload])
     return n
 

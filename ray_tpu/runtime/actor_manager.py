@@ -759,3 +759,13 @@ class ActorManager:
                 "Name": r.name, "Pending": len(r.queue),
                 "InFlight": len(r.inflight),
             } for a, r in self._actors.items()]
+
+    def actors_on_rows(self, rows) -> set[bytes]:
+        """Actor-id binaries currently placed on the given node rows
+        (the serve router demotes replicas living on SUSPECT nodes)."""
+        rows = set(rows)
+        if not rows:
+            return set()
+        with self._lock:
+            return {a.binary() for a, r in self._actors.items()
+                    if r.row in rows}

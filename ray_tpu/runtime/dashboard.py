@@ -95,6 +95,17 @@ class Dashboard(BackgroundHTTPServer):
                 return request_plane_stats()
             except Exception:   # noqa: BLE001 — serve absent/unused
                 return {}
+        if name == "health":
+            from ..rpc import breaker, chaos
+            cluster = self._cluster
+            out = cluster.health.stats()
+            out["suspect_rows"] = cluster.crm.suspect_rows()
+            out["breakers"] = breaker.stats()
+            out["chaos"] = chaos.status()
+            plane = getattr(cluster, "plane", None)
+            if plane is not None:
+                out["blacklisted_sources"] = plane.blacklisted_sources()
+            return out
         return None
 
     def _summary(self, nodes=None, actors=None, tasks=None) -> dict:
@@ -186,6 +197,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
             '<a href="/api/serve">serve</a> · '
+            '<a href="/api/health">health</a> · '
             '<a href="/api/stacks">stacks</a> · '
             '<a href="/api/timeline">timeline</a> · '
             '<a href="/api/jobs">jobs</a> · '

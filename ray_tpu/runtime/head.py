@@ -150,13 +150,30 @@ class HeadNode:
             "worker_stacks": self._worker_stacks,
             "list_named_actors": self._list_named_actors,
             "request_resources": self._request_resources,
-            "job_submit": self.jobs.submit,
+            "job_submit": self._job_submit,
             "job_status": self.jobs.status,
             "job_list": self.jobs.list,
             "job_logs": self.jobs.logs,
             "job_stop": self.jobs.stop,
             "stop_daemon": self._stop_async,
+            "chaos": self._chaos,
         }
+
+    def _job_submit(self, *args, **kwargs) -> str:
+        """Submit, then snapshot synchronously: a job acked by a
+        persistent head must survive kill -9 right after the ack —
+        the 2 s persist tick alone leaves a durability window where
+        a restarted head has never heard of the job."""
+        job_id = self.jobs.submit(*args, **kwargs)
+        if self._persist_path:
+            self._snapshot()
+        return job_id
+
+    def _chaos(self, op: str, **kwargs) -> dict:
+        """Runtime control of the seeded network-chaos plane (shared
+        dispatch with the CLI — ``rpc/chaos.py``)."""
+        from ..rpc import chaos
+        return chaos.control(op, **kwargs)
 
     # -- client-mode surface -------------------------------------------------
     def _ping(self) -> dict:
@@ -351,7 +368,23 @@ class HeadNode:
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
             "serve": self._serve_stats(),
+            "health": self._health_stats(cluster),
+            "chaos": self._chaos_stats(),
         }
+
+    @staticmethod
+    def _health_stats(cluster) -> dict:
+        from ..rpc import breaker
+        health = getattr(cluster, "health", None)
+        out = health.stats() if health is not None else {}
+        out["suspect_rows"] = cluster.crm.suspect_rows()
+        out["breakers"] = breaker.stats()
+        return out
+
+    @staticmethod
+    def _chaos_stats() -> dict:
+        from ..rpc import chaos
+        return chaos.status() if chaos.is_enabled() else {"enabled": False}
 
     @staticmethod
     def _serve_stats() -> dict:

@@ -10,8 +10,11 @@ In-process adaptation: a node is declared DEAD on structural failure —
 its scheduling thread died or its worker pool is wiped out (all
 processes dead and respawn broken) — for ``threshold`` consecutive
 probes, then drained via ``cluster.remove_node``.  Event-loop
-responsiveness (pong answered since our previous ping) is tracked and
-surfaced as ``suspect`` in stats but is deliberately NOT fatal: a loop
+responsiveness (pong answered since our previous ping) and data-plane
+reachability (an OPEN circuit breaker on the node's object-plane
+address — see ``rpc/breaker.py``) are tracked and surfaced as
+``suspect``, mirrored into the CRM so placement rounds soft-avoid the
+node, but are deliberately NOT fatal: a loop
 blocked 40 s in a first jit compile is indistinguishable in-process from
 a wedged one, and upstream only gets hang-detection for free because a
 hung raylet process also stops answering its RPC thread.  The head node
@@ -40,6 +43,7 @@ class HealthCheckManager:
         #            "suspect": bool}
         self._state: dict = {}
         self.num_detected = 0
+        self.num_quarantined = 0    # rows currently breaker-quarantined
 
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -67,18 +71,40 @@ class HealthCheckManager:
                 import traceback
                 traceback.print_exc()
 
+    def quarantined_rows(self) -> set[int]:
+        """Rows whose object-plane address currently has an OPEN circuit
+        breaker (computed live from the rpc breaker registry: repeated
+        transfer failures to a node quarantine it here even while its
+        control-plane vitals look fine — the classic gray failure)."""
+        from ..rpc import breaker as _breaker
+        open_addrs = _breaker.open_peers()
+        if not open_addrs:
+            return set()
+        return {row for row, addr in self._cluster.planes.items()
+                if addr is not None and addr in open_addrs}
+
+    def suspect_nodes(self) -> list:
+        """NodeIDs currently flagged suspect (loop-lag or quarantine)."""
+        return [nid for nid, st in self._state.items() if st["suspect"]]
+
     def check_once(self) -> list:
         """One probe round.  Returns nodes declared dead this round
         (tests call this directly for determinism)."""
         cluster = self._cluster
         declared = []
+        quarantined = self.quarantined_rows()
+        self.num_quarantined = len(quarantined)
         for row, raylet in list(cluster.raylets.items()):
             nid = raylet.node_id
             st = self._state.setdefault(
                 nid, {"misses": 0, "pinged_at": None, "suspect": False})
             vitals = raylet.health_vitals()
             st["suspect"] = (st["pinged_at"] is not None and
-                            vitals["last_pong"] < st["pinged_at"])
+                            vitals["last_pong"] < st["pinged_at"]) or \
+                row in quarantined
+            # mirror into the CRM so scheduling rounds soft-avoid the
+            # row (advisory: snapshot() never masks suspect nodes)
+            cluster.crm.set_suspect(row, bool(st["suspect"]))
             if vitals["thread_alive"] and vitals["workers_alive"]:
                 st["misses"] = 0
             else:
@@ -110,4 +136,5 @@ class HealthCheckManager:
         return {"num_detected": self.num_detected,
                 "num_monitored": len(self._state),
                 "num_suspect": sum(s["suspect"]
-                                   for s in self._state.values())}
+                                   for s in self._state.values()),
+                "num_quarantined": self.num_quarantined}

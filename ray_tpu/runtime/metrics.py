@@ -86,6 +86,12 @@ def render_metrics(cluster) -> str:
          "Bytes transferred by pulls (cumulative)", out=out)
     _fmt("pull_manager_inflight_bytes", ps["inflight_bytes"],
          "Bytes in active transfers", out=out)
+    obj_plane = getattr(cluster, "plane", None)
+    if obj_plane is not None:
+        _fmt("object_plane_blacklisted_sources",
+             len(obj_plane.blacklisted_sources()),
+             "Transfer sources currently blacklisted for repeated "
+             "failures", out=out)
 
     # ownership / lineage
     ts = cluster.task_manager.stats()
@@ -102,6 +108,34 @@ def render_metrics(cluster) -> str:
     # health + autoscaler + events
     _fmt("health_nodes_declared_dead_total", cluster.health.num_detected,
          "Nodes declared dead by health checks (cumulative)", out=out)
+    hs = cluster.health.stats()
+    _fmt("health_suspect_nodes", hs["num_suspect"],
+         "Nodes flagged suspect (loop-lag or breaker quarantine)",
+         out=out)
+    _fmt("health_quarantined_nodes", hs["num_quarantined"],
+         "Nodes with an OPEN circuit breaker on their plane link",
+         out=out)
+    from ..rpc import breaker as _breaker
+    bs = _breaker.stats()
+    _fmt("rpc_breakers_open",
+         sum(1 for b in bs.values() if b["state"] == "open"),
+         "Peer circuit breakers currently open", out=out)
+    _fmt("rpc_breaker_opens_total",
+         sum(b["opens"] for b in bs.values()),
+         "Circuit-breaker open transitions (cumulative)", out=out)
+    from ..rpc import chaos as _chaos
+    ch = _chaos.active()
+    if ch is not None:
+        cs = ch.status()
+        for key, help_text in (
+                ("num_dropped", "Messages dropped by chaos injection"),
+                ("num_duplicated",
+                 "Messages duplicated by chaos injection"),
+                ("num_delayed", "Messages delayed by chaos injection"),
+                ("num_partitioned",
+                 "Messages dropped by directed partitions")):
+            _fmt(f"chaos_{key}", cs[key], help_text + " (cumulative)",
+                 out=out)
     if cluster.autoscaler is not None:
         a = cluster.autoscaler.stats()
         _fmt("autoscaler_nodes_launched_total", a["num_launched"],

@@ -232,8 +232,12 @@ class NodeAgent:
         try:                        # a racing reconnect loop
             while True:
                 try:
+                    # agent_fn (function-bytes fetch) is an idempotent
+                    # read: let it ride out gray head links with retry
                     self._head = RpcClient(head_address,
-                                           on_close=self._on_head_lost)
+                                           on_close=self._on_head_lost,
+                                           retryable=frozenset(
+                                               {"agent_fn"}))
                     self.agent_id = NodeID.from_random().hex()
                     reply = self._head.call(
                         "agent_register", self.agent_id,
@@ -341,7 +345,8 @@ class NodeAgent:
                 head = None
                 try:
                     head = RpcClient(self._head_address,
-                                     on_close=self._on_head_lost)
+                                     on_close=self._on_head_lost,
+                                     retryable=frozenset({"agent_fn"}))
                     # install the link BEFORE registering: the register
                     # call blocks on worker-ready frames, which the new
                     # pump threads relay through self._head/agent_id

@@ -99,6 +99,13 @@ class ObjectPlane:
         self.window_peak = 0            # high-water mark of the above
         self.last_transfer_mbps = 0.0   # most recent completed transfer
         self.ewma_transfer_mbps = 0.0   # smoothed across transfers
+        # source blacklist: addr -> [consecutive failures, last failure
+        # monotonic].  A source that times out / short-chunks repeatedly
+        # is skipped for plane_source_blacklist_s while ANY other
+        # replica remains — striped pulls stop re-trying a gray link on
+        # every transfer (failures also feed its peer circuit breaker)
+        self._blk_lock = threading.Lock()
+        self._src_fail: dict[str, list] = {}
 
     # -- serving side (attach to an RpcServer) ------------------------------
     def handlers(self) -> dict:
@@ -174,12 +181,45 @@ class ObjectPlane:
             "plane_window_peak": self.window_peak,
             "plane_last_transfer_mbps": round(self.last_transfer_mbps, 2),
             "plane_ewma_transfer_mbps": round(self.ewma_transfer_mbps, 2),
+            "plane_blacklisted_sources": len(self.blacklisted_sources()),
         }
 
     def _op_plane_stats(self) -> dict:
         s = self.store.stats()
         s.update(self.stats())
         return s
+
+    # -- source blacklist (gray-failure quarantine for striped pulls) --------
+    def _note_source_failure(self, addr: str) -> None:
+        from ..rpc import breaker as _breaker
+        _breaker.record_failure(addr)
+        now = time.monotonic()
+        ttl = get_config().plane_source_blacklist_s
+        with self._blk_lock:
+            row = self._src_fail.get(addr)
+            if row is None or now - row[1] > ttl:
+                self._src_fail[addr] = [1, now]
+            else:
+                row[0] += 1
+                row[1] = now
+
+    def _note_source_ok(self, addr: str) -> None:
+        with self._blk_lock:
+            self._src_fail.pop(addr, None)
+
+    def _blacklisted(self, addr: str) -> bool:
+        cfg = get_config()
+        with self._blk_lock:
+            row = self._src_fail.get(addr)
+            if row is None:
+                return False
+            if time.monotonic() - row[1] > cfg.plane_source_blacklist_s:
+                del self._src_fail[addr]    # decayed: forgiven
+                return False
+            return row[0] >= cfg.plane_source_blacklist_failures
+
+    def blacklisted_sources(self) -> list[str]:
+        return [a for a in list(self._src_fail) if self._blacklisted(a)]
 
     # -- pulling side --------------------------------------------------------
     def pull_into_local(self, oid: ObjectID, size: int, src_addr: str,
@@ -199,6 +239,11 @@ class ObjectPlane:
         for a in (src_addr, *src_addrs):
             if a and a != self.serve_address and a not in sources:
                 sources.append(a)
+        # skip blacklisted sources while any clean replica remains (a
+        # fully-blacklisted set still pulls: degraded beats impossible)
+        clean = [a for a in sources if not self._blacklisted(a)]
+        if clean:
+            sources = clean
         # -- first round-trip: chunk 0 doubles as the stat ------------------
         # (trust the SOURCE's size: the request's size came from the
         # metadata seal and is authoritative, but the piggybacked stat
@@ -218,6 +263,7 @@ class ObjectPlane:
                         "op_stat", oid.binary(), timeout=30.0)
             except Exception:   # noqa: BLE001 — peer gone: try the next
                 self._drop_peer(addr)
+                self._note_source_failure(addr)
                 sources.remove(addr)
                 continue
             if src_kind in _SERVABLE and src_size > 0:
@@ -262,6 +308,7 @@ class ObjectPlane:
         else:
             self.bytes_received_pickled += src_size
         self.transfers_in += 1
+        self._note_source_ok(primary)
         return True
 
     def _pipelined_fetch(self, oid: ObjectID, handle, start: int,
@@ -328,6 +375,7 @@ class ObjectPlane:
                 return
             dead.add(addr)
             self._drop_peer(addr)
+            self._note_source_failure(addr)
             survivors = [a for a in srcs if a not in dead]
             if not survivors:
                 return      # the pump/drain loop raises
@@ -481,7 +529,13 @@ class ObjectPlane:
             client = self._peers.get(address)
             if client is not None and not client._closed:
                 return client
-        client = RpcClient(address)
+        # plane reads are idempotent: retry on timeout/conn-loss, and
+        # enforce the peer's circuit breaker so a quarantined link fails
+        # fast into the blacklist instead of eating a chunk timeout
+        client = RpcClient(address,
+                           retryable=frozenset({"op_stat", "op_free",
+                                                "op_plane_stats"}),
+                           breaker=True)
         with self._peers_lock:
             live = self._peers.get(address)
             if live is not None and not live._closed:

@@ -139,6 +139,10 @@ class Raylet:
         # died between pipeline_target and the commit)
         self._assigned_total = 0
         self._avoid_local: set[TaskID] = set()  # lease-spilled: skip here
+        # last _effective_snapshot soft-masked SUSPECT rows out (gray
+        # failures): tells _schedule_rows a -1 deserves a full-cluster
+        # fallback pass before parking the task
+        self._suspect_softmask = False
         self._stopped = False
         # DRAINING: no new leases commit here, running tasks finish;
         # the pool and event loop stay alive (unlike _stopped) so the
@@ -439,6 +443,29 @@ class Raylet:
     # -- batch scheduling ---------------------------------------------------
     def _schedule_rows(self, batch: list) -> list[int]:
         """Choose a node row for every task record in the batch.
+
+        Two-pass suspect avoidance: the first pass runs with SUSPECT
+        rows (gray failures flagged by the health manager) soft-masked
+        out of the snapshot; any task that pass could not place retries
+        against the full cluster — a degraded node beats parking
+        feasible work, but only as a last resort.
+        """
+        rows = self._schedule_rows_soft(batch)
+        if self._suspect_softmask and any(r < 0 for r in rows):
+            snapshot = self._effective_snapshot(avoid_suspect=False)
+            n_rows = snapshot.node_mask.shape[0]
+            for t, r in enumerate(rows):
+                if r >= 0:
+                    continue
+                spec = batch[t].spec
+                req = spec.resources.dense(self.crm.resource_index,
+                                           snapshot.totals.shape[1])
+                rows[t] = self._policy.schedule(
+                    snapshot, req, self._options_for(spec, n_rows))
+        return rows
+
+    def _schedule_rows_soft(self, batch: list) -> list[int]:
+        """First placement pass (suspect rows soft-masked).
 
         Returns one row per record (-1 = infeasible/park).  Uses the device
         water-fill kernel for large uniform batches, the CPU policy
@@ -749,13 +776,29 @@ class Raylet:
                                     axis=1)
         return counts
 
-    def _effective_snapshot(self):
+    def _effective_snapshot(self, avoid_suspect: bool = True):
         """CRM snapshot minus every node's planned-but-undispatched load
         AND its agent-locally-running load (tasks an autonomous agent
         leased without the head — reported on the batched agent_sync),
         so placement rounds do not over-assign nodes whose queues or
-        local leases are already deep."""
+        local leases are already deep.
+
+        With ``avoid_suspect`` (the default), SUSPECT rows are masked
+        out too — but only while at least one healthy node survives,
+        and ``self._suspect_softmask`` records that the mask was
+        applied so ``_schedule_rows`` knows a -1 merits a full-cluster
+        retry (suspect is advisory, never a hard exclusion)."""
         snapshot = self.crm.snapshot()
+        self._suspect_softmask = False
+        if avoid_suspect:
+            sus = self.crm.suspect_mask()
+            n = min(sus.shape[0], snapshot.node_mask.shape[0])
+            if sus[:n].any():
+                healthy = snapshot.node_mask.copy()
+                healthy[:n] &= ~sus[:n]
+                if healthy.any():
+                    snapshot.node_mask = healthy
+                    self._suspect_softmask = True
         for row, raylet in list(self.cluster.raylets.items()):
             planned = raylet.planned_snapshot()
             local = raylet.agent_local_cu

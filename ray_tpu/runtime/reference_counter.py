@@ -93,6 +93,11 @@ class ReferenceCounter:
         self._expects_seal = None           # callback(oid) -> bool
         self._stop = False
         self._thread: threading.Thread | None = None
+        # serializes concurrent flush() calls: the reclaimer thread and
+        # direct callers (tests, teardown barriers) may fold at the same
+        # time, and the batch-pop below is only safe when exactly one
+        # thread pops (appends stay lock-free — __del__ never waits here)
+        self._flush_lock = threading.Lock()
 
     # -- hot path (any thread, __del__-safe: no locks) -----------------------
     def incref(self, object_id: ObjectID, holder: tuple = DRIVER) -> None:
@@ -192,7 +197,14 @@ class ReferenceCounter:
         while not self._stop:
             self._wake.wait(timeout=0.5)
             self._wake.clear()
-            self.flush()
+            try:
+                self.flush()
+            except Exception:   # noqa: BLE001 — the reclaimer thread
+                # must survive a bad fold (a dead reclaimer leaks every
+                # object from here on); the events that folded before
+                # the failure are applied, the rest re-fold next wake
+                import traceback
+                traceback.print_exc()
 
     def _total(self, oid: ObjectID) -> int:
         return self._tot.get(oid, 0)
@@ -230,28 +242,36 @@ class ReferenceCounter:
         reclaimer thread (tests may call it directly for determinism).
         Loops until both the queue and the dead list drain: reclaiming a
         parent enqueues decrefs for its contained refs."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         events = self._events
         popleft = events.popleft
         while True:
             dead = []
             processed = False
-            # len() is a safe batch bound: this thread is the only
-            # popper, so at least that many entries exist — popping by
-            # count skips a try/except per event on the hot fold
+            # len() is a safe batch bound: _flush_lock makes this thread
+            # the only popper, so at least that many entries exist —
+            # popping by count skips a try/except per event on the hot
+            # fold (the IndexError guard below is pure defense)
             while (n := len(events)):
                 processed = True
                 dead_holders = self._dead_holders
                 bump = self._bump
-                for _ in range(n):
-                    kind, oid, arg = popleft()
-                    if kind == "+":
-                        if arg not in dead_holders:
-                            bump(oid, arg, 1, dead)
-                    elif kind == "-":
-                        if arg not in dead_holders:
-                            bump(oid, arg, -1, dead)
-                    else:
-                        self._fold_rare(kind, oid, arg, dead)
+                try:
+                    for _ in range(n):
+                        kind, oid, arg = popleft()
+                        if kind == "+":
+                            if arg not in dead_holders:
+                                bump(oid, arg, 1, dead)
+                        elif kind == "-":
+                            if arg not in dead_holders:
+                                bump(oid, arg, -1, dead)
+                        else:
+                            self._fold_rare(kind, oid, arg, dead)
+                except IndexError:
+                    break   # queue drained under us; fold what we have
             for oid in dead:
                 if oid in self._pinned or self._total(oid) > 0:
                     continue

@@ -48,6 +48,12 @@ class ClusterResourceManager:
         # heartbeats still sync) but every placement view masks them out,
         # so no new work lands there while the drain completes
         self.draining = np.zeros(self._capacity, dtype=bool)
+        # SUSPECT rows (gray failures: slow event loop, open circuit
+        # breaker on the node's data-plane link) are SOFT-avoided: the
+        # raylet's placement rounds skip them while any healthy node
+        # fits, but fall back to them rather than parking feasible work
+        # — unlike draining, suspect never hides a node from snapshot()
+        self.suspect = np.zeros(self._capacity, dtype=bool)
         self._row_of: dict[NodeID, int] = {}
         self._id_of: dict[int, NodeID] = {}
         self._labels: dict[int, dict[str, str]] = {}
@@ -66,6 +72,7 @@ class ClusterResourceManager:
                 self.avail[row, self._col(name)] = cu
             self.node_mask[row] = True
             self.draining[row] = False
+            self.suspect[row] = False
             self._row_of[node_id] = row
             self._id_of[row] = node_id
             self._labels[row] = dict(resources.labels)
@@ -83,6 +90,7 @@ class ClusterResourceManager:
             self.avail[row] = 0
             self.node_mask[row] = False
             self.draining[row] = False
+            self.suspect[row] = False
             self.version += 1
 
     # -- drain lifecycle (ALIVE -> DRAINING -> removed) ---------------------
@@ -108,6 +116,25 @@ class ClusterResourceManager:
             return [int(r) for r in
                     np.flatnonzero(self.node_mask & self.draining)]
 
+    # -- suspect lifecycle (gray failure: soft-avoid, never mask) -----------
+    def set_suspect(self, row: int, flag: bool = True) -> None:
+        """Mark/unmark a row suspect (the health manager mirrors its
+        loop-suspect + breaker-quarantine view here each round)."""
+        with self._lock:
+            if 0 <= row < self._capacity and \
+                    bool(self.suspect[row]) != flag:
+                self.suspect[row] = flag
+                self.version += 1
+
+    def suspect_mask(self) -> np.ndarray:
+        with self._lock:
+            return (self.node_mask & self.suspect).copy()
+
+    def suspect_rows(self) -> list[int]:
+        with self._lock:
+            return [int(r) for r in
+                    np.flatnonzero(self.node_mask & self.suspect)]
+
     def _alloc_row(self) -> int:
         free = np.flatnonzero(~self.node_mask)
         # prefer rows never used / lowest index: deterministic traversal order
@@ -131,6 +158,9 @@ class ClusterResourceManager:
         drain = np.zeros(cap, dtype=bool)
         drain[:self._capacity] = self.draining
         self.draining = drain
+        sus = np.zeros(cap, dtype=bool)
+        sus[:self._capacity] = self.suspect
+        self.suspect = sus
         self._capacity = cap
 
     def _col(self, name: str) -> int:

@@ -197,6 +197,24 @@ def cmd_status(args) -> int:
             print(f"  draining: {d['node_id'][:16]}… "
                   f"reason={d.get('reason') or '-'} "
                   f"deadline_s={d.get('deadline_s')}")
+    health = st.get("health") or {}
+    if health.get("num_suspect") or health.get("num_quarantined") \
+            or health.get("suspect_rows"):
+        print(f"  suspect: rows={health.get('suspect_rows')} "
+              f"(loop-lag or quarantine; soft-avoided by the "
+              f"scheduler), quarantined={health.get('num_quarantined', 0)}")
+    for addr, b in (health.get("breakers") or {}).items():
+        print(f"  breaker {addr}: {b['state']} "
+              f"failures={b['failures']} opens={b['opens']}")
+    ch = st.get("chaos") or {}
+    if ch.get("enabled"):
+        print(f"chaos: seed={ch['seed']} drop_p={ch['drop_p']} "
+              f"dup_p={ch['dup_p']} delay={ch['delay_ms']}ms"
+              f"@p={ch['delay_p']} bw={ch['bandwidth_mbps']}MB/s "
+              f"partitions={ch['partitions']} "
+              f"injected: drop={ch['num_dropped']} "
+              f"dup={ch['num_duplicated']} delay={ch['num_delayed']} "
+              f"part={ch['num_partitioned']}")
     print("resources:")
     total, avail = st["cluster_resources"], st["available_resources"]
     for name in sorted(total):
@@ -259,6 +277,47 @@ def cmd_drain(args) -> int:
         client.close()
     print(f"{st['node_id'][:16]}…  {st['state']} "
           f"deadline_s={st['deadline_s']} reason={st['reason']}")
+    return 0
+
+
+def cmd_chaos(args) -> int:
+    """``ray_tpu chaos`` — control the head's seeded network-chaos
+    plane (``rpc/chaos.py``): inject drops/dups/delays, partition and
+    heal links, read the injected-fault trace."""
+    if args.off:
+        op, kw = "off", {}
+    elif args.partition:
+        op, kw = "partition", {"src": args.partition[0],
+                               "dst": args.partition[1]}
+    elif args.heal:
+        op, kw = "heal", {"src": args.src, "dst": args.dst}
+    elif args.trace:
+        op, kw = "trace", {}
+    elif args.reset_trace:
+        op, kw = "reset_trace", {}
+    elif any(v is not None for v in (args.seed, args.drop, args.dup,
+                                     args.delay_p, args.delay_ms,
+                                     args.bandwidth_mbps)):
+        op = "set"
+        kw = {"seed": args.seed or 0,
+              "drop_p": args.drop or 0.0,
+              "dup_p": args.dup or 0.0,
+              "delay_p": args.delay_p or 0.0,
+              "delay_ms": args.delay_ms or 0.0,
+              "bandwidth_mbps": args.bandwidth_mbps or 0.0}
+    else:
+        op, kw = "status", {}
+    # every chaos op is idempotent (set replaces, partition/heal are
+    # set ops, status/trace read) — retry so the control plane stays
+    # usable against the very fault injection it is steering
+    from ..rpc import RpcClient
+    client = RpcClient(_resolve_address(args.address),
+                       retryable=frozenset({"chaos"}))
+    try:
+        out = client.call("chaos", op, **kw, timeout=30.0)
+    finally:
+        client.close()
+    print(json.dumps(out, indent=2, default=str))
     return 0
 
 
@@ -487,6 +546,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: drain_deadline_s config)")
     pd.add_argument("--address", default=None)
     pd.set_defaults(fn=cmd_drain)
+
+    pc = sub.add_parser(
+        "chaos", help="control the seeded network-chaos plane "
+        "(drop/dup/delay injection, partitions, fault trace)")
+    pc.add_argument("--address", default=None)
+    pc.add_argument("--seed", type=int, default=None)
+    pc.add_argument("--drop", type=float, default=None,
+                    help="per-message drop probability")
+    pc.add_argument("--dup", type=float, default=None,
+                    help="per-message duplication probability")
+    pc.add_argument("--delay-p", type=float, default=None,
+                    help="per-message delay probability")
+    pc.add_argument("--delay-ms", type=float, default=None,
+                    help="mean injected delay (ms)")
+    pc.add_argument("--bandwidth-mbps", type=float, default=None,
+                    help="per-connection bandwidth cap (MB/s)")
+    pc.add_argument("--partition", nargs=2, metavar=("SRC", "DST"),
+                    default=None,
+                    help="add a directed partition SRC ↛ DST "
+                    "('*' = wildcard)")
+    pc.add_argument("--heal", action="store_true",
+                    help="remove partitions (all, or --src/--dst)")
+    pc.add_argument("--src", default=None)
+    pc.add_argument("--dst", default=None)
+    pc.add_argument("--status", action="store_true")
+    pc.add_argument("--trace", action="store_true",
+                    help="dump the injected-fault trace")
+    pc.add_argument("--reset-trace", action="store_true",
+                    help="clear streams+trace (replay from draw 0)")
+    pc.add_argument("--off", action="store_true")
+    pc.set_defaults(fn=cmd_chaos)
 
     pl = sub.add_parser("list", help="list live cluster state")
     pl.add_argument("kind", choices=["tasks", "actors", "objects",

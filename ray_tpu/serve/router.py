@@ -176,6 +176,8 @@ class RequestRouter:
         self._stats = _Stats()
         self._store = None
         self._store_checked = False
+        self._suspect_keys: set[bytes] = set()  # replicas on gray nodes
+        self._suspect_at = 0.0
 
     # -- environment ---------------------------------------------------------
     def _driver_store(self):
@@ -247,6 +249,31 @@ class RequestRouter:
     def _load_locked(self, replica) -> int:
         return self._inflight.get(replica._actor_id.binary(), 0)
 
+    def _refresh_suspects_locked(self) -> set[bytes]:
+        """Actor-id binaries of replicas on SUSPECT nodes (gray
+        failures flagged by the health manager).  Observable only on
+        the in-process driver — client mode and workers see an empty
+        set (the head's scheduler still soft-avoids those nodes).
+        Cached ~1 s so the per-request cost is a clock read."""
+        now = _now()
+        if now - self._suspect_at < 1.0:
+            return self._suspect_keys
+        self._suspect_at = now
+        keys: set[bytes] = set()
+        try:
+            from ray_tpu.api import _get_runtime
+            rt = _get_runtime()
+            cluster = getattr(rt, "cluster", None)
+            am = getattr(rt, "actor_manager", None)
+            if cluster is not None and am is not None:
+                rows = cluster.crm.suspect_rows()
+                if rows:
+                    keys = am.actors_on_rows(rows)
+        except Exception:   # noqa: BLE001 — health view is best-effort
+            keys = set()
+        self._suspect_keys = keys
+        return keys
+
     def _pick_locked(self, mux: str, capped: bool = True):
         """Power-of-two-choices among replicas with a free slot; a
         multiplexed model id overrides with rendezvous hashing so one
@@ -257,6 +284,15 @@ class RequestRouter:
         reps = self._replicas
         if not reps:
             return None
+        # demote replicas on quarantined/suspect nodes: route around
+        # them while ANY healthy replica exists (a fully-suspect
+        # replica set keeps serving — degraded beats down)
+        suspects = self._refresh_suspects_locked()
+        if suspects:
+            healthy = [r for r in reps
+                       if r._actor_id.binary() not in suspects]
+            if healthy:
+                reps = healthy
         cap = self._cfg.get("max_ongoing", 4)
         if mux and len(reps) > 1:
             import hashlib

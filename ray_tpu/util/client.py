@@ -51,7 +51,15 @@ class ClientRuntime:
         from ..rpc import RpcClient
         self.address = address
         self.namespace = namespace or ""
-        self._rpc = RpcClient(address)
+        # idempotent head READS transparently retry on timeout/conn
+        # loss (backoff + full jitter); mutations (submit/put/create)
+        # never do — re-issuing those would double-execute
+        self._rpc = RpcClient(address, retryable=frozenset({
+            "ping", "status", "nodes", "available_resources",
+            "cluster_resources", "list_named_actors",
+            "get_actor_by_name", "job_status", "job_list", "job_logs",
+            "state_list", "timeline", "memory",
+        }))
         self._lock = threading.Lock()
         # this process's share of distributed refcounting: ObjectRefs
         # built here count locally; batches ship ahead of the next RPC

@@ -35,6 +35,20 @@ def _fresh_config():
     Config.reset()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_fault_state():
+    """Chaos and circuit-breaker state are process-global (like Config):
+    a chaos test must never leak drops into the next test, and a
+    breaker opened by one test's dead peer must not quarantine an
+    unrelated test that lands on a reused ephemeral port."""
+    from ray_tpu.rpc import breaker, chaos
+    chaos.disable()
+    breaker.reset_registry()
+    yield
+    chaos.disable()
+    breaker.reset_registry()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -31,7 +31,9 @@ ray_tpu.init(address="auto")
 
 @ray_tpu.remote(resources={{"slot": 1}})
 def work(i):
-    time.sleep(0.2)
+    with open({start!r}, "w") as f:   # signals "mid-job" to the test
+        f.write("x")
+    time.sleep(0.5)
     return i * 2
 
 out = sorted(ray_tpu.get([work.remote(i) for i in range(8)],
@@ -104,9 +106,10 @@ class TestHeadFailover:
         address = f"127.0.0.1:{port}"
         persist = str(tmp_path / "gcs.snap")
         marker = str(tmp_path / "job_done.txt")
+        start = str(tmp_path / "job_started.txt")
         script = str(tmp_path / "job.py")
         with open(script, "w") as f:
-            f.write(JOB_SCRIPT.format(marker=marker))
+            f.write(JOB_SCRIPT.format(marker=marker, start=start))
 
         head = _start_head(port, persist)
         agents = []
@@ -114,12 +117,20 @@ class TestHeadFailover:
             client = _wait_head(address)
             agents = [_start_agent(address), _start_agent(address)]
             _wait_nodes(client, 3)
-            # a slow job: 8 tasks x 0.2s on one remote worker slot pair
+            # a slow job: 8 tasks x 0.5s on one remote worker slot pair
             job_id = client.call(
                 "job_submit", f"{sys.executable} {script}",
                 timeout=30.0)
-            # let it get going, then murder the head mid-flight
-            time.sleep(2.0)
+            # murder the head the moment a task is observed running —
+            # the first task is still in its 0.5s sleep, so the job
+            # cannot have finished (a fixed pre-kill sleep raced the
+            # job's ~2s runtime and lost on a fast box)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if os.path.exists(start):
+                    break
+                time.sleep(0.02)
+            assert os.path.exists(start), "job never started"
             assert not os.path.exists(marker)
             os.kill(head.pid, signal.SIGKILL)
             head.wait(timeout=30)

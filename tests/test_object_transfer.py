@@ -572,3 +572,54 @@ class TestStripeSourceDeath:
             if child.poll() is None:
                 child.kill()
             child.wait(10)
+
+
+@pytest.mark.chaos
+class TestPartitionedSource:
+    def test_partitioned_source_fenced_and_pull_fails_over(
+            self, endpoints):
+        """Directed partition dest -> src1: probes trip src1's circuit
+        breaker, so the striped pull fails FAST over to the clean
+        replica (the plane's breaker=True peer clients never eat the
+        60s chunk timeout) and the partitioned source is noted in the
+        blacklist ledger.  Healing + closing the breaker restores it."""
+        from ray_tpu.rpc import RpcClient, breaker, chaos
+        Config.reset({"object_transfer_chunk_mb": 1,
+                      "object_transfer_stripe_min_mb": 2,
+                      "rpc_breaker_failure_threshold": 2,
+                      "rpc_breaker_reset_s": 60.0})
+        payload = b"\x5a" * (4 << 20)
+        src1, src2 = endpoints("src1"), endpoints("src2")
+        oid = _oid()
+        size = src1.seal(oid, payload)
+        assert src2.seal(oid, payload) == size
+
+        chaos.add_partition("*", src1.address)
+        # gray link: probes to src1 time out and open its breaker
+        probe = RpcClient(src1.address, timeout=1.0)
+        try:
+            for _ in range(2):
+                with pytest.raises(TimeoutError):
+                    probe.call("op_stat", oid.binary(), timeout=0.2)
+        finally:
+            probe.close()
+        assert breaker.is_open(src1.address)
+
+        dest = endpoints("dest")
+        t0 = time.monotonic()
+        assert dest.plane.pull_into_local(
+            oid, size, src1.address, (src2.address,))
+        assert time.monotonic() - t0 < 10, "failover was not fast"
+        assert dest.store.peek(oid) == payload
+        # src1 was fenced: not one chunk request crossed the partition
+        assert src1.server.method_calls.get("op_fetch") is None
+        assert src1.address in dest.plane._src_fail
+        assert dest.plane.transfers_failed == 0
+
+        # heal + close the breaker: src1 serves again
+        chaos.heal()
+        breaker.record_success(src1.address)
+        dest2 = endpoints("dest2")
+        assert dest2.plane.pull_into_local(oid, size, src1.address)
+        assert dest2.store.peek(oid) == payload
+        assert src1.server.method_calls.get("op_fetch", 0) >= 1

@@ -256,3 +256,66 @@ class TestReconstruction:
         finally:
             ray_tpu.shutdown()
             c.stop()
+
+
+class TestConcurrentFlush:
+    def test_concurrent_flush_folds_every_event_exactly_once(self):
+        """Regression: two threads folding at once (the reclaimer loop
+        plus a direct flush() from a test/teardown barrier) used to
+        race the batch pop — len() was read by both, each popped "its"
+        count, and the second popper hit an empty deque mid-batch,
+        losing the rest of its fold.  flush() now serializes poppers,
+        so balanced +/- traffic from many holders folds to exactly
+        zero no matter how many flushers overlap the producers."""
+        import threading as _threading
+        from ray_tpu.common.ids import ObjectID
+        from ray_tpu.runtime.reference_counter import ReferenceCounter
+
+        rc = ReferenceCounter()
+        reclaimed = []
+        rc._reclaim = reclaimed.append
+        oids = [ObjectID.from_random() for _ in range(32)]
+        n_producers, rounds = 4, 400
+        start = _threading.Barrier(n_producers + 2)
+        stop_flushing = _threading.Event()
+        errors = []
+
+        def produce(k):
+            holder = ("w", k)
+            try:
+                start.wait()
+                for i in range(rounds):
+                    oid = oids[(k + i) % len(oids)]
+                    rc.incref(oid, holder)
+                    rc.decref(oid, holder)
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errors.append(e)
+
+        def flusher():
+            try:
+                start.wait()
+                while not stop_flushing.is_set():
+                    rc.flush()
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errors.append(e)
+
+        producers = [_threading.Thread(target=produce, args=(k,))
+                     for k in range(n_producers)]
+        flushers = [_threading.Thread(target=flusher) for _ in range(2)]
+        for t in producers + flushers:
+            t.start()
+        for t in producers:
+            t.join(60)
+            assert not t.is_alive(), "producer hung"
+        stop_flushing.set()
+        for t in flushers:
+            t.join(60)
+            assert not t.is_alive(), "flusher hung"
+        assert not errors, errors
+        rc.flush()      # drain whatever the racing flushers left queued
+        s = rc.stats()
+        assert s["queued_events"] == 0
+        assert s["num_tracked"] == 0, "lost decrefs left phantom counts"
+        assert s["num_holders"] == 0
+        for oid in oids:
+            assert rc.count_of(oid) == 0
