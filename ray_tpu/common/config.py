@@ -288,6 +288,16 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         float, 0.2,
         "Smoothing factor for the per-deployment request-latency EWMA "
         "the router feeds the autoscaler (higher = more reactive)."),
+    # -- concurrency invariants (rtlint) ------------------------------------
+    "rtlint_runtime_lock_order": (
+        bool, False,
+        "Instrument threading.Lock/RLock construction (common/"
+        "lockorder.py) to record the REAL lock-acquisition-order "
+        "digraph, keyed by allocation site; the chaos/drain suites "
+        "assert it stays acyclic.  Dynamic complement of rtlint's "
+        "static W2 rule — catches cross-object nesting static "
+        "analysis cannot see.  Test/debug only: adds per-acquire "
+        "bookkeeping to every lock constructed while enabled."),
     # -- observability ------------------------------------------------------
     "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
     "dashboard_port": (int, 0, "0 disables the dashboard HTTP server."),

@@ -17,6 +17,7 @@ for a reply that died on the server.
 
 from __future__ import annotations
 
+import logging
 import socket
 import threading
 import traceback
@@ -24,6 +25,8 @@ import traceback
 from . import chaos as _chaos
 from .wire import (RawResult, recv_raw_frame, send_raw_frame,
                    send_raw_reply)
+
+_LOG = logging.getLogger("ray_tpu.rpc.server")
 
 
 class RpcServer:
@@ -173,7 +176,10 @@ class RpcServer:
                 try:
                     cb()
                 except Exception:   # noqa: BLE001 — cleanup must not
-                    pass            # kill the conn reaper
+                    # kill the conn reaper, but a dying hook is a bug
+                    # in its owner: keep the evidence
+                    _LOG.debug("connection cleanup hook failed",
+                               exc_info=True)
 
     def _run_handler(self, conn, wlock, req_id, method, args,
                      kwargs) -> None:

@@ -21,6 +21,7 @@ TPU scheduling data plane) and serves two client surfaces over
 
 from __future__ import annotations
 
+import logging
 import threading
 
 from ..common.ids import ActorID, JobID, ObjectID, TaskID
@@ -86,11 +87,15 @@ class HeadNode:
             self._rt.cluster.save_gcs_snapshot(self._persist_path)
 
     def _persist_loop(self) -> None:
+        log = logging.getLogger("ray_tpu.head")
         while not self._stop_event.wait(2.0):
             try:
                 self._snapshot()
             except Exception:   # noqa: BLE001 — a failed snapshot must
-                pass            # not kill the daemon; next tick retries
+                # not kill the daemon (next tick retries), but silent
+                # persistence loss turns a later failover into data loss
+                log.warning("gcs snapshot failed; retrying next tick",
+                            exc_info=True)
 
     @property
     def address(self) -> str:

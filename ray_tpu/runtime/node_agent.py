@@ -44,6 +44,7 @@ only resolve on agents sharing that filesystem.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import shutil
@@ -54,6 +55,8 @@ from collections import deque
 
 from ..common.ids import NodeID, ObjectID, TaskID
 from .worker_pool import LocalSpawner
+
+_LOG = logging.getLogger("ray_tpu.node_agent")
 
 _EOF = object()
 
@@ -332,8 +335,9 @@ class NodeAgent:
         for proc, conn in workers:
             try:
                 proc.terminate()
-            except Exception:   # noqa: BLE001
-                pass
+            except Exception:   # noqa: BLE001 — keep reaping the rest
+                _LOG.debug("terminating stale worker failed",
+                           exc_info=True)
         with self._pin_lock:
             self._exec_pins.clear()
             self._get_pins.clear()
@@ -1113,7 +1117,9 @@ class NodeAgent:
                 self._head.call("agent_sync", self.agent_id, batch,
                                 load)
             except Exception:   # noqa: BLE001 — head gone: the
-                pass            # on_close/reconnect flow owns cleanup
+                # on_close/reconnect flow owns cleanup; log so a sync
+                # silently failing for OTHER reasons is visible
+                _LOG.debug("agent_sync to head failed", exc_info=True)
 
     # -- worker->head pump ---------------------------------------------------
     def _pump(self, index: int, conn, epoch: int = 0) -> None:
@@ -1128,7 +1134,10 @@ class NodeAgent:
             try:
                 msg = self._rewrite_up(index, msg)
             except Exception:   # noqa: BLE001 — surgery must not drop
-                pass            # the frame; forward as-is
+                # the frame; forward as-is, but a failing rewrite is a
+                # protocol bug worth surfacing
+                _LOG.warning("frame rewrite failed; forwarding raw",
+                             exc_info=True)
             if msg is None:
                 continue        # fully handled locally (autonomy path)
             try:

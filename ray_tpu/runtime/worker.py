@@ -31,6 +31,7 @@ Frames (tuples, first element is the kind):
 from __future__ import annotations
 
 import contextvars
+import logging
 import os
 import queue
 import sys
@@ -164,8 +165,10 @@ class WorkerApiContext:
                 try:
                     self.send(("stacks_reply", msg[1],
                                _format_all_stacks()))
-                except Exception:   # noqa: BLE001 — diagnostics only
-                    pass
+                except Exception:   # noqa: BLE001 — diagnostics only;
+                    # the reader must survive, but record the failure
+                    logging.getLogger("ray_tpu.worker").debug(
+                        "stack-dump reply failed", exc_info=True)
             elif msg[0] == "node_info":
                 # which node hosts this worker (runtime-context
                 # surface) — set from the reader so it is visible even

@@ -434,6 +434,34 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Run rtlint (tools/rtlint) — the project-native concurrency &
+    invariant analyzer — over the package.  Exit 0 when every finding
+    is baselined; non-zero otherwise, so it can gate PRs."""
+    import ray_tpu
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    if not os.path.isdir(os.path.join(repo_root, "tools", "rtlint")):
+        print("ray_tpu lint: tools/rtlint not found next to the package "
+              f"(looked under {repo_root}); run it from a source checkout",
+              file=sys.stderr)
+        return 2
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.rtlint.__main__ import main as rtlint_main
+    forward = []
+    if args.format != "text":
+        forward.append(f"--format={args.format}")
+    if args.update_baseline:
+        forward.append("--update-baseline")
+    if args.no_baseline:
+        forward.append("--no-baseline")
+    if args.rules:
+        forward.append(f"--rules={args.rules}")
+    forward.append(f"--root={repo_root}")
+    return rtlint_main(forward)
+
+
 def cmd_microbenchmark(args) -> int:
     """Single-node perf suite (reference ``ray microbenchmark``,
     BASELINE config #1: many tiny tasks)."""
@@ -623,6 +651,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="single-node perf suite")
     pb.add_argument("--num-tasks", type=int, default=2000)
     pb.set_defaults(fn=cmd_microbenchmark)
+
+    plint = sub.add_parser(
+        "lint",
+        help="concurrency & invariant analyzer (rtlint): blocking-"
+             "under-lock, lock-order cycles, config-knob discipline, "
+             "thread lifecycle; non-zero exit on non-baselined findings")
+    plint.add_argument("--format", choices=("text", "json"),
+                       default="text")
+    plint.add_argument("--rules", default=None,
+                       help="comma-separated subset of W1,W2,W3,W4")
+    plint.add_argument("--update-baseline", action="store_true",
+                       help="accept current findings into "
+                            "tools/rtlint/baseline.json")
+    plint.add_argument("--no-baseline", action="store_true",
+                       help="report every finding, ignore the baseline")
+    plint.set_defaults(fn=cmd_lint)
     return p
 
 

@@ -49,6 +49,24 @@ def _fresh_fault_state():
     breaker.reset_registry()
 
 
+@pytest.fixture(autouse=True)
+def _runtime_lock_order():
+    """rtlint's dynamic mode: when the ``rtlint_runtime_lock_order``
+    knob is on (RT_RTLINT_RUNTIME_LOCK_ORDER=1), every lock constructed
+    during a test is instrumented; after the test the OBSERVED
+    acquisition-order digraph must be acyclic.  Asserting per test (then
+    resetting) attributes a cycle to the test whose workload produced
+    it.  Off by default: zero overhead."""
+    from ray_tpu.common import lockorder
+    installed = lockorder.maybe_install_from_config()
+    yield
+    if installed:
+        try:
+            lockorder.assert_acyclic()
+        finally:
+            lockorder.reset()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

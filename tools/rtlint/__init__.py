@@ -1,0 +1,38 @@
+"""rtlint — project-native concurrency & invariant analyzer for ray_tpu.
+
+A stdlib-``ast`` static pass over the package that enforces the
+invariants this codebase has already paid for in bugs:
+
+    W1  blocking-call-under-lock   RPC / socket / sleep / join lexically
+                                   inside a ``with <lock>`` block
+    W2  lock-order-cycle           the global acquires-while-holding
+                                   digraph must stay acyclic
+    W3  config-knob-discipline     every config attribute read must name
+                                   a ``_CONFIG_DEFS`` knob; every knob
+                                   must be read somewhere; docs non-empty
+    W4  thread-lifecycle           spawned threads are daemon or joined;
+                                   pump loops don't silently swallow
+                                   their own death
+
+Run it:
+
+    ray_tpu lint                    # CLI wrapper
+    python -m tools.rtlint          # same thing, explicit
+
+Existing accepted sites live in ``tools/rtlint/baseline.json``
+(``--update-baseline`` regenerates it deterministically); anything NOT
+in the baseline fails the run, so the suite starts green and ratchets.
+
+The dynamic complement lives in ``ray_tpu/common/lockorder.py``: a
+config-gated (``rtlint_runtime_lock_order``) instrumented lock wrapper
+that records REAL acquisition order during the chaos/drain tests and
+asserts the observed graph stays acyclic — static analysis proposes,
+the chaos plane disposes.
+"""
+
+from .finding import Finding
+from .analyzer import run_analysis, iter_package_files
+
+__all__ = ["Finding", "run_analysis", "iter_package_files"]
+
+__version__ = "1.0"

@@ -1,0 +1,119 @@
+"""Analysis driver: file discovery, rule dispatch, baseline filtering."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import baseline as baseline_mod
+from . import rules_knobs, rules_locks, rules_threads
+from .finding import Finding, sort_key
+
+ALL_RULES = ("W1", "W2", "W3", "W4")
+
+
+class FileCtx:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        self.module = os.path.splitext(os.path.basename(relpath))[0]
+        with open(abspath, "r", encoding="utf-8") as f:
+            src = f.read()
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=abspath)
+
+
+def iter_package_files(pkg_dir: str) -> list[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def run_analysis(repo_root: str, package: str = "ray_tpu",
+                 rules=ALL_RULES, files=None) -> list[Finding]:
+    """Run the selected rules over ``<repo_root>/<package>``; returns
+    ALL findings (baseline not applied here).
+
+    ``files``: optional explicit file list (absolute paths) — used by
+    the fixture tests to lint snippets without a package tree.
+    """
+    pkg_dir = os.path.join(repo_root, package)
+    if files is None:
+        files = iter_package_files(pkg_dir)
+    ctxs = []
+    findings: list[Finding] = []
+    for path in files:
+        rel = os.path.relpath(path, repo_root)
+        try:
+            ctxs.append(FileCtx(path, rel))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="E0", path=rel.replace(os.sep, "/"),
+                line=e.lineno or 0, symbol="<parse>",
+                message=f"syntax error: {e.msg}", detail="syntax-error"))
+
+    lock_passes = []
+    knob_refs: set[str] = set()
+    knob_strings: set[str] = set()
+    config_abs = os.path.join(pkg_dir, "common", "config.py")
+    defs = rules_knobs.load_defs(config_abs) if \
+        ("W3" in rules and os.path.exists(config_abs)) else {}
+
+    for ctx in ctxs:
+        if "W1" in rules or "W2" in rules:
+            w1, fpass = rules_locks.scan_file(ctx)
+            lock_passes.append(fpass)
+            if "W1" in rules:
+                findings.extend(w1)
+        if defs:
+            kf, refs, strings = rules_knobs.scan_file(ctx, defs)
+            # config.py itself mentions every knob as a dict key: its
+            # string constants must not count as references
+            if not ctx.path.endswith("common/config.py"):
+                findings.extend(kf)
+                knob_refs |= refs
+                knob_strings |= strings
+        if "W4" in rules:
+            findings.extend(rules_threads.scan_file(ctx))
+
+    if "W1" in rules and lock_passes:
+        findings.extend(rules_locks.interprocedural_w1(lock_passes))
+    if "W2" in rules and lock_passes:
+        adj = rules_locks.build_graph(lock_passes)
+        findings.extend(rules_locks.cycle_findings(adj))
+    if defs:
+        config_rel = os.path.relpath(config_abs, repo_root).replace(
+            os.sep, "/")
+        findings.extend(rules_knobs.global_findings(
+            defs, knob_refs, knob_strings, config_rel))
+
+    return sorted(findings, key=sort_key)
+
+
+def lock_graph(repo_root: str, package: str = "ray_tpu") -> dict:
+    """The static acquires-while-holding digraph (for tests/tools)."""
+    pkg_dir = os.path.join(repo_root, package)
+    passes = []
+    for path in iter_package_files(pkg_dir):
+        ctx = FileCtx(path, os.path.relpath(path, repo_root))
+        _, p = rules_locks.scan_file(ctx)
+        passes.append(p)
+    return rules_locks.build_graph(passes)
+
+
+def check(repo_root: str, package: str = "ray_tpu", rules=ALL_RULES,
+          baseline_path: str | None = None):
+    """Full run + baseline split.
+
+    Returns (new, baselined, stale, all_findings).
+    """
+    findings = run_analysis(repo_root, package, rules)
+    accepted = baseline_mod.load(baseline_path) if baseline_path else {}
+    new, based, stale = baseline_mod.split(findings, accepted)
+    return new, based, stale, findings
