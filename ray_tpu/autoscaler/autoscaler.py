@@ -19,13 +19,13 @@ tasks, with ``autoscaler_update_interval_ms`` as the fallback tick.
 from __future__ import annotations
 
 import threading
-import time
 
 import numpy as np
 
 from ..common.config import get_config
 from ..common.resources import ResourceRequest
 from .demand import NodeTypeSpec, get_nodes_to_launch
+from ..common import clock as _clk
 
 NODE_TYPE_LABEL = "node-type"       # CRM label carrying the launch type
 
@@ -212,7 +212,7 @@ class StandardAutoscaler:
         waiting (possibly forever) for idleness."""
         cluster = self._cluster
         cfg = get_config()
-        now = time.monotonic()
+        now = _clk.monotonic()
         totals, avail, mask = cluster.crm.arrays()
         drain_mask = cluster.crm.draining
         terminated = []

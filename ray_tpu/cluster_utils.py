@@ -18,7 +18,6 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
-import time
 import uuid
 
 import numpy as np
@@ -36,6 +35,7 @@ from .runtime.recovery import ObjectRecoveryManager
 from .runtime.reference_counter import ReferenceCounter
 from .runtime.task_manager import TaskManager
 from .scheduling.cluster_resources import ClusterResourceManager
+from .common import clock as _clk
 
 # default simulated link rates (MB/s): same-node "transfers" are free;
 # inter-node defaults to a 10 GB/s ICI-class link until overridden via
@@ -412,7 +412,7 @@ class Cluster:
             self.crm.set_draining(node_id, True)
             st = {"node_id": node_id.hex(), "row": row, "reason": reason,
                   "deadline_s": float(deadline_s), "state": "DRAINING",
-                  "outcome": None, "started": time.monotonic(),
+                  "outcome": None, "started": _clk.monotonic(),
                   "migrated_objects": 0, "displaced_groups": 0}
             self._drains[node_id] = st
             raylet = self.raylets.get(row)
@@ -487,10 +487,10 @@ class Cluster:
             if raylet is None or (raylet.drain_empty() and not migrating
                                   and not sole):
                 outcome = "drained"
-            elif time.monotonic() >= deadline:
+            elif _clk.monotonic() >= deadline:
                 outcome = "deadline"    # grace expired: forced removal
             else:
-                time.sleep(poll)
+                _clk.sleep(poll)
                 continue
             try:
                 self.remove_node(node_id)
@@ -503,7 +503,7 @@ class Cluster:
                       outcome: str) -> None:
         st["outcome"] = outcome
         st["state"] = "DEAD" if outcome == "dead" else "REMOVED"
-        st["elapsed_s"] = round(time.monotonic() - st["started"], 3)
+        st["elapsed_s"] = round(_clk.monotonic() - st["started"], 3)
         self.events.emit("node", "node_drain_finished",
                          node_row=st["row"], node_id=st["node_id"],
                          outcome=outcome, elapsed_s=st["elapsed_s"],

@@ -298,6 +298,30 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "static W2 rule — catches cross-object nesting static "
         "analysis cannot see.  Test/debug only: adds per-acquire "
         "bookkeeping to every lock constructed while enabled."),
+    # -- in-process simulator (ray_tpu/sim/) --------------------------------
+    "sim_heartbeat_period_s": (
+        float, 5.0,
+        "Virtual-time heartbeat period of simulated nodes; also the "
+        "simulated head's monitor tick."),
+    "sim_heartbeat_miss_threshold": (
+        int, 3,
+        "Consecutive missed heartbeat periods before the simulated "
+        "head declares a node dead and requeues its leases."),
+    "sim_lease_timeout_s": (
+        float, 20.0,
+        "Virtual seconds a granted lease may run without an ack before "
+        "the simulated head requeues the task (lost-ack recovery)."),
+    "sim_drain_deadline_s": (
+        float, 45.0,
+        "Virtual deadline for a simulated drain to converge; past it "
+        "the node is force-removed and leftover leases requeued."),
+    "sim_node_capacity": (
+        int, 4,
+        "Concurrent lease slots per simulated node."),
+    "sim_boot_delay_s": (
+        float, 3.0,
+        "Virtual delay between an autoscaler launch decision and the "
+        "new simulated node registering."),
     # -- observability ------------------------------------------------------
     "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
     "dashboard_port": (int, 0, "0 disables the dashboard HTTP server."),

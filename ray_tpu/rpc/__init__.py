@@ -18,8 +18,11 @@ reference's gRPC carries daemon-to-daemon traffic.
 from .breaker import CircuitOpenError
 from .client import RemoteRpcError, RpcClient, RpcConnectionError, RpcFuture
 from .server import RpcServer
+from .transport import (TcpTransport, Transport, connect, get_transport,
+                        serve)
 from .wire import RawReply, RawResult
 
 __all__ = ["RpcServer", "RpcClient", "RpcConnectionError",
            "RemoteRpcError", "RpcFuture", "RawReply", "RawResult",
-           "CircuitOpenError"]
+           "CircuitOpenError", "Transport", "TcpTransport",
+           "get_transport", "connect", "serve"]

@@ -31,8 +31,8 @@ soft-avoided by the scheduler, and demoted by the serve router.
 from __future__ import annotations
 
 import threading
-import time
 
+from ..common import clock as _clk
 from .client import RpcConnectionError
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -65,7 +65,7 @@ class PeerBreaker:
             if self.state == CLOSED:
                 return True
             if self.state == OPEN:
-                if time.monotonic() - self.opened_at >= self.reset_s:
+                if _clk.monotonic() - self.opened_at >= self.reset_s:
                     self.state = HALF_OPEN
                     self.probing = True
                     return True
@@ -87,21 +87,21 @@ class PeerBreaker:
             if self.state == HALF_OPEN:
                 # failed probe: straight back to OPEN, clock restarted
                 self.state = OPEN
-                self.opened_at = time.monotonic()
+                self.opened_at = _clk.monotonic()
                 self.probing = False
                 self.opens += 1
                 return
             self.failures += 1
             if self.state == CLOSED and self.failures >= self.threshold:
                 self.state = OPEN
-                self.opened_at = time.monotonic()
+                self.opened_at = _clk.monotonic()
                 self.opens += 1
 
     def snapshot(self) -> dict:
         with self.lock:
             return {"state": self.state, "failures": self.failures,
                     "opens": self.opens,
-                    "open_for_s": (round(time.monotonic() - self.opened_at, 3)
+                    "open_for_s": (round(_clk.monotonic() - self.opened_at, 3)
                                    if self.state == OPEN else 0.0)}
 
 

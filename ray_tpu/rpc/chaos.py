@@ -48,7 +48,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
+
+from ..common import clock as _clk
 
 __all__ = ["configure", "disable", "add_partition", "heal", "trace",
            "reset_trace", "status", "control", "active", "is_enabled",
@@ -177,7 +178,7 @@ class _Chaos:
             self.num_duplicated += 1
         if delay:
             self.num_delayed += 1
-            time.sleep(delay)
+            _clk.sleep(delay)
         return action
 
     def send_action(self, peer: str) -> str | None:
@@ -215,6 +216,24 @@ class _Chaos:
             return "drop"
         return self._decide(f"srv:{self_addr}", self_addr)
 
+    def link_action(self, src: str, dst: str) -> str | None:
+        """Virtual-link leg for the in-process simulator: one seeded
+        decision on directed link ``src->dst``.  Same Philox keying and
+        fixed draw count as the socket legs, so a simulated campaign's
+        drop/dup/delay schedule replays bit-for-bit from the seed.
+        Per-peer ``links`` overrides and directed partitions key by
+        ``dst`` / ``(src, dst)`` exactly like the socket path."""
+        if self._partitioned(src, dst):
+            self.num_partitioned += 1
+            link = self._link(f"{src}->{dst}")
+            with link.lock:
+                n = link.n
+                link.n += 1
+                if len(link.trace) < _TRACE_CAP:
+                    link.trace.append((n, "part"))
+            return "drop"
+        return self._decide(f"{src}->{dst}", dst)
+
     # -- bandwidth pacing (wire seam) ----------------------------------------
     def pace(self, sock, nbytes: int) -> None:
         """Token pacing per connection: sending ``nbytes`` reserves
@@ -224,7 +243,7 @@ class _Chaos:
         if rate <= 0 or nbytes <= 0:
             return
         key = id(sock)
-        now = time.monotonic()
+        now = _clk.monotonic()
         with self._pace_lock:
             if len(self._pace_next) > 512:          # bound stale entries
                 self._pace_next = {k: v for k, v in
@@ -232,7 +251,7 @@ class _Chaos:
             start = max(now, self._pace_next.get(key, 0.0))
             self._pace_next[key] = start + nbytes / rate
         if start > now:
-            time.sleep(start - now)
+            _clk.sleep(start - now)
 
     # -- introspection -------------------------------------------------------
     def trace(self) -> dict:

@@ -43,8 +43,8 @@ import itertools
 import random
 import socket
 import threading
-import time
 
+from ..common import clock as _clk
 from . import chaos as _chaos
 from .wire import recv_reply, send_frame
 
@@ -192,7 +192,7 @@ class RpcClient:
                         raise
                 # exponential backoff with FULL jitter (decorrelates
                 # retry storms from many clients hitting one gray peer)
-                time.sleep(random.random() * min(cap, base * 2 ** attempt))
+                _clk.sleep(random.random() * min(cap, base * 2 ** attempt))
                 continue
             _breaker.record_success(peer)
             return result

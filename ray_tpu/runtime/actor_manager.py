@@ -32,6 +32,7 @@ from ..scheduling.policy import SchedulingOptions
 from .object_ref import ObjectRef
 from .serialization import (ActorDiedError, RayTaskError, deserialize,
                             serialize)
+from ..common import clock as _clk
 
 _MAX_INFLIGHT = 16          # pipelining window per actor
 
@@ -412,8 +413,7 @@ class ActorManager:
                     self._seal_call_error(call.task_id, call.num_returns, dep_err)
                     continue
                 rec.inflight[call.task_id.binary()] = call
-                import time as _time
-                call.sent_at = _time.time()
+                call.sent_at = _clk.now()
                 from .object_ref import (mark_transferred,
                                          transfer_generators)
                 with transfer_generators() as gens:
@@ -483,10 +483,9 @@ class ActorManager:
             if call is None:
                 return True
             if call.trace_ctx is not None:
-                import time as _time
                 self._cluster.events.span(
                     "actor_task", call.method[:24], call.sent_at,
-                    _time.time(), rec.row if rec is not None else -1,
+                    _clk.now(), rec.row if rec is not None else -1,
                     status=kind, trace_id=call.trace_ctx[0],
                     parent_id=call.trace_ctx[1],
                     span_id=call.task_id.hex())

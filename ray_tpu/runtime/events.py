@@ -18,10 +18,10 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from collections import deque
 
 from ..common.config import get_config
+from ..common import clock as _clk
 
 _RING = 65536           # bounded timeline memory (spans)
 
@@ -42,7 +42,7 @@ class EventLog:
         timeline ring.  No-op when ``event_log_enabled`` is false."""
         if not self.enabled:
             return
-        ev = {"ts": time.time(), "category": category, "name": name,
+        ev = {"ts": _clk.now(), "category": category, "name": name,
               **fields}
         with self._lock:
             self.num_events += 1

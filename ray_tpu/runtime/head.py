@@ -43,7 +43,7 @@ class HeadNode:
         noted in JobManager.restore_jobs)."""
         import os
         from .. import api
-        from ..rpc import RpcServer
+        from ..rpc import transport as _transport
         from ..rpc.xlang_gateway import XlangGateway
         from .job_manager import JobManager
         api.init(resources=resources, num_workers=num_workers,
@@ -55,7 +55,8 @@ class HeadNode:
         self._persist_path = persist_path
         if persist_path and os.path.exists(persist_path):
             self._rt.cluster.restore_gcs_snapshot(persist_path)
-        self.server = RpcServer(self._handlers(), host=host, port=port)
+        self.server = _transport.serve(self._handlers(), host=host,
+                                       port=port)
         self.server.start()
         # cross-language surface (C++ frontend); xlang_port=None disables
         self.xlang = None if xlang_port is None else \

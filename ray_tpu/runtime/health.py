@@ -25,9 +25,9 @@ GCS lives there).
 from __future__ import annotations
 
 import threading
-import time
 
 from ..common.config import get_config
+from ..common import clock as _clk
 
 
 class HealthCheckManager:
@@ -124,7 +124,7 @@ class HealthCheckManager:
                     except ValueError:
                         pass        # raced with a manual/autoscaler removal
                     continue
-            st["pinged_at"] = time.monotonic()
+            st["pinged_at"] = _clk.monotonic()
             raylet.ping()
         # forget departed nodes
         live = {r.node_id for r in cluster.raylets.values()}

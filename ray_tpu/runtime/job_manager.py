@@ -18,7 +18,7 @@ import os
 import shlex
 import subprocess
 import threading
-import time
+from ..common import clock as _clk
 
 
 class JobInfo:
@@ -33,7 +33,7 @@ class JobInfo:
         self.status = "PENDING"
         self.metadata = metadata
         self.runtime_env = runtime_env
-        self.start_time = time.time()
+        self.start_time = _clk.now()
         self.end_time: float | None = None
         self.log_path = log_path
         self.proc: subprocess.Popen | None = None
@@ -170,7 +170,7 @@ class JobManager:
             log_f.write(f"failed to start: {e}\n".encode())
             log_f.close()
             info.status = "FAILED"
-            info.end_time = time.time()
+            info.end_time = _clk.now()
             self._persist(info)
             return job_id
         info.status = "RUNNING"
@@ -184,7 +184,7 @@ class JobManager:
         log_f.close()
         with self._lock:
             info.return_code = rc
-            info.end_time = time.time()
+            info.end_time = _clk.now()
             if info.status != "STOPPED":
                 info.status = "SUCCEEDED" if rc == 0 else "FAILED"
         self._persist(info)
@@ -239,10 +239,10 @@ class JobManager:
 
     def wait(self, job_id: str, timeout: float = 120.0) -> dict:
         """Block until the job leaves PENDING/RUNNING (test helper)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = _clk.monotonic() + timeout
+        while _clk.monotonic() < deadline:
             st = self.status(job_id)
             if st["status"] not in ("PENDING", "RUNNING"):
                 return st
-            time.sleep(0.05)
+            _clk.sleep(0.05)
         raise TimeoutError(f"job {job_id} still running after {timeout}s")

@@ -55,6 +55,7 @@ from collections import deque
 
 from ..common.ids import NodeID, ObjectID, TaskID
 from .worker_pool import LocalSpawner
+from ..common import clock as _clk
 
 _LOG = logging.getLogger("ray_tpu.node_agent")
 
@@ -137,7 +138,7 @@ class NodeAgent:
         and re-registers as a fresh node (local workers of the dead
         head's pool are reaped, the local store resets — the restarted
         head has no directory entries for it)."""
-        from ..rpc import RpcClient, RpcServer
+        from ..rpc import transport as _transport
         from .object_plane import ObjectPlane
         from .object_store import MemoryStore
         self._head_address = head_address
@@ -222,22 +223,22 @@ class NodeAgent:
             "a_drain": self._a_drain,
         }
         handlers.update(self.plane.handlers())
-        self.server = RpcServer(handlers, host=host, port=port).start()
+        self.server = _transport.serve(handlers, host=host,
+                                       port=port).start()
         self.plane.serve_address = self.server.address
         # head link: frames flow agent->head on this client; its loss
         # (head died) ends the agent — or, with reconnect enabled,
         # triggers the retry/re-register loop.  The INITIAL registration
         # retries under the same budget: a head dying mid-register must
         # not strand a reconnect-enabled agent
-        import time as _time
-        deadline = _time.monotonic() + max(reconnect_timeout_s, 0.0)
+        deadline = _clk.monotonic() + max(reconnect_timeout_s, 0.0)
         self._reconnecting = True   # a mid-register drop must not fork
         try:                        # a racing reconnect loop
             while True:
                 try:
                     # agent_fn (function-bytes fetch) is an idempotent
                     # read: let it ride out gray head links with retry
-                    self._head = RpcClient(head_address,
+                    self._head = _transport.connect(head_address,
                                            on_close=self._on_head_lost,
                                            retryable=frozenset(
                                                {"agent_fn"}))
@@ -249,12 +250,12 @@ class NodeAgent:
                     self._apply_register_reply(reply, resources)
                     break
                 except Exception:
-                    if _time.monotonic() >= deadline:
+                    if _clk.monotonic() >= deadline:
                         raise
                     with self._lock:    # epoch bump quiets stale pumps
                         self._epoch += 1
                         self._workers.clear()
-                    _time.sleep(1.0)
+                    _clk.sleep(1.0)
         finally:
             with self._lock:
                 self._reconnecting = False
@@ -323,9 +324,8 @@ class NodeAgent:
         """The head died: reap the dead pool's local workers, reset the
         local store (the restarted head has no directory rows for it),
         and re-register as a fresh node until the timeout lapses."""
-        import time
-        from ..rpc import RpcClient
-        deadline = time.monotonic() + self._reconnect_timeout
+        from ..rpc import transport as _transport
+        deadline = _clk.monotonic() + self._reconnect_timeout
         # new epoch FIRST: surviving pump threads of the dead head's
         # workers go quiet instead of relaying colliding indices
         with self._lock:
@@ -345,10 +345,10 @@ class NodeAgent:
         self.store.delete([oid for oid, _s, _k
                            in self.store.list_objects()])
         try:
-            while time.monotonic() < deadline and not self._stopping:
+            while _clk.monotonic() < deadline and not self._stopping:
                 head = None
                 try:
-                    head = RpcClient(self._head_address,
+                    head = _transport.connect(self._head_address,
                                      on_close=self._on_head_lost,
                                      retryable=frozenset({"agent_fn"}))
                     # install the link BEFORE registering: the register
@@ -366,7 +366,7 @@ class NodeAgent:
                 except Exception:   # noqa: BLE001 — head still down
                     if head is not None:
                         head.close()
-                    time.sleep(1.0)
+                    _clk.sleep(1.0)
             self._stop_event.set()
         finally:
             with self._lock:
@@ -783,11 +783,10 @@ class NodeAgent:
             for k, v in cu.items():
                 if self._totals_cu.get(k, 0) < v:
                     return False    # infeasible here, ever
-            import time as _time
             entry = {"spec": spec, "spec_bytes": spec_bytes,
                      "fn_id": fn_id, "fn_bytes": fn_bytes,
                      "submitter": submitter, "cu": cu,
-                     "enq": _time.monotonic()}
+                     "enq": _clk.monotonic()}
             # started rides the sync BEFORE any dispatch: the result
             # can arrive arbitrarily fast, and its done entry must
             # never reach the head in a flush preceding registration.
@@ -820,7 +819,6 @@ class NodeAgent:
         — here we only stop the wasted work: drop a queued entry, or
         (force) kill the worker running it (its death handback finds
         the record done at the head and is skipped)."""
-        import time as _time
         with self._view_lock:
             for e in list(self._local_queue):
                 if e["spec"].task_id.binary() == tid_bin:
@@ -830,7 +828,7 @@ class NodeAgent:
         if entry is None:
             # dispatch window: the drain popped the queue entry but
             # has not inserted the running record yet — re-check once
-            _time.sleep(0.1)
+            _clk.sleep(0.1)
             entry = self._local_tasks.get(tid_bin)
             if entry is None:
                 return "unknown"
@@ -1083,10 +1081,9 @@ class NodeAgent:
         """Ship started/done/load batches to the head: amortized (a
         2 ms coalescing window after the first append) so a fan-out of
         N local leases costs O(1) head frames, not O(N)."""
-        import time
         while not self._stopping and not self._stop_event.is_set():
             if self._sync_wake.wait(timeout=0.5):
-                time.sleep(0.002)       # coalesce a burst
+                _clk.sleep(0.002)       # coalesce a burst
                 self._sync_wake.clear()
             # stale local leases (queued past the lease timeout behind
             # blocked/busy workers) spill back to the head for global
@@ -1096,7 +1093,7 @@ class NodeAgent:
             # the case that must still spill
             from ..common.config import get_config
             stale_after = get_config().worker_lease_timeout_ms / 1000.0
-            now = time.monotonic()
+            now = _clk.monotonic()
             stale = []
             with self._view_lock:
                 while self._local_queue and \
@@ -1219,11 +1216,11 @@ class AgentSpawner:
     """The WorkerPool spawner seam, backed by one registered agent."""
 
     def __init__(self, agent_address: str, on_disconnect=None):
-        from ..rpc import RpcClient
+        from ..rpc import transport as _transport
         self._conns: dict[int, _RemoteConn] = {}
         self._lock = threading.Lock()
         self._closed = False
-        self._client = RpcClient(agent_address,
+        self._client = _transport.connect(agent_address,
                                  on_close=self._handle_disconnect)
         self._on_disconnect = on_disconnect
 

@@ -50,11 +50,11 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
-import time
 from collections import deque
 
 from ..common.config import get_config
 from ..common.ids import ObjectID
+from ..common import clock as _clk
 
 # payload-serving kinds (a "remote" entry has no local bytes to serve)
 _SERVABLE = ("shm", "spill")
@@ -193,7 +193,7 @@ class ObjectPlane:
     def _note_source_failure(self, addr: str) -> None:
         from ..rpc import breaker as _breaker
         _breaker.record_failure(addr)
-        now = time.monotonic()
+        now = _clk.monotonic()
         ttl = get_config().plane_source_blacklist_s
         with self._blk_lock:
             row = self._src_fail.get(addr)
@@ -213,7 +213,7 @@ class ObjectPlane:
             row = self._src_fail.get(addr)
             if row is None:
                 return False
-            if time.monotonic() - row[1] > cfg.plane_source_blacklist_s:
+            if _clk.monotonic() - row[1] > cfg.plane_source_blacklist_s:
                 del self._src_fail[addr]    # decayed: forgiven
                 return False
             return row[0] >= cfg.plane_source_blacklist_failures
@@ -282,7 +282,7 @@ class ObjectPlane:
             # landing (~3x the cost on a cold arena block)
             threading.Thread(target=handle.prefault,
                              name="plane-prefault", daemon=True).start()
-        t0 = time.monotonic()
+        t0 = _clk.monotonic()
         try:
             got = 0
             if raw and first_data is not None and len(first_data) > 0:
@@ -296,7 +296,7 @@ class ObjectPlane:
             handle.abort()
             self.transfers_failed += 1
             return False
-        dt = max(time.monotonic() - t0, 1e-9)
+        dt = max(_clk.monotonic() - t0, 1e-9)
         mbps = src_size / (1 << 20) / dt
         self.last_transfer_mbps = mbps
         self.ewma_transfer_mbps = (mbps if self.ewma_transfer_mbps == 0
@@ -469,10 +469,10 @@ class ObjectPlane:
                 for (addr, _o, _l), fut in inflight.items():
                     if not fut.done():
                         self._drop_peer(addr)
-                deadline = time.monotonic() + 5.0
+                deadline = _clk.monotonic() + 5.0
                 for fut in inflight.values():
                     if not fut.wait(max(0.0,
-                                        deadline - time.monotonic())):
+                                        deadline - _clk.monotonic())):
                         break
                 # occupancy must not leak
                 self.window_occupancy -= len(inflight)
@@ -524,7 +524,7 @@ class ObjectPlane:
 
     # -- peer cache ----------------------------------------------------------
     def _peer(self, address: str):
-        from ..rpc import RpcClient
+        from ..rpc import transport as _transport
         with self._peers_lock:
             client = self._peers.get(address)
             if client is not None and not client._closed:
@@ -532,10 +532,10 @@ class ObjectPlane:
         # plane reads are idempotent: retry on timeout/conn-loss, and
         # enforce the peer's circuit breaker so a quarantined link fails
         # fast into the blacklist instead of eating a chunk timeout
-        client = RpcClient(address,
-                           retryable=frozenset({"op_stat", "op_free",
-                                                "op_plane_stats"}),
-                           breaker=True)
+        client = _transport.connect(address,
+                                    retryable=frozenset({"op_stat", "op_free",
+                                                         "op_plane_stats"}),
+                                    breaker=True)
         with self._peers_lock:
             live = self._peers.get(address)
             if live is not None and not live._closed:

@@ -25,13 +25,13 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Iterable, Sequence
 
 from ..common.ids import ObjectID
 from .serialization import RayError, RayTaskError, deserialize
+from ..common import clock as _clk
 
 
 class GetTimeoutError(RayError, TimeoutError):
@@ -567,7 +567,7 @@ class MemoryStore:
             if not missing:
                 return True
             if deadline is not None:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - _clk.monotonic()
                 if remaining <= 0:
                     return False
                 self._cv.wait(remaining)
@@ -577,7 +577,7 @@ class MemoryStore:
     def get(self, object_ids: Sequence[ObjectID],
             timeout: float | None = None) -> list:
         """Blocking fetch of all ids (in order). Raises stored errors."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else _clk.monotonic() + timeout
         with self._cv:
             if not self._await_locked(object_ids, deadline):
                 missing = sum(o not in self._objects for o in object_ids)
@@ -594,14 +594,14 @@ class MemoryStore:
              timeout: float | None = None
              ) -> tuple[list[ObjectID], list[ObjectID]]:
         """ray.wait semantics: (ready, not_ready), order-preserving."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else _clk.monotonic() + timeout
         with self._cv:
             while True:
                 ready = [o for o in object_ids if o in self._objects]
                 if len(ready) >= num_returns:
                     break
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - _clk.monotonic()
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)
@@ -618,7 +618,7 @@ class MemoryStore:
         """Block until every id EXISTS (any entry kind, including
         metadata-only RemoteEntry); no materialization.  False on
         timeout."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else _clk.monotonic() + timeout
         with self._cv:
             return self._await_locked(object_ids, deadline)
 
@@ -627,7 +627,7 @@ class MemoryStore:
         """Blocking fetch WITHOUT error unwrap — stored RayTaskError values
         are returned as values (the worker-side get re-raises them).
         Returns None on timeout."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else _clk.monotonic() + timeout
         with self._cv:
             if not self._await_locked(object_ids, deadline):
                 return None
@@ -639,7 +639,7 @@ class MemoryStore:
         """Blocking fetch of wire descriptors for a worker reply: shm
         objects ship as (offset, size) for zero-copy reads, small ones as
         in-band values.  Returns None on timeout."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else _clk.monotonic() + timeout
         with self._cv:
             if not self._await_locked(object_ids, deadline):
                 return None

@@ -18,7 +18,6 @@ ticker as a safety net).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -27,6 +26,7 @@ from ..common.ids import ObjectID, PlacementGroupID, TaskID
 from ..common.resources import ResourceRequest, from_cu
 from ..scheduling.bundles import PlacementStrategy, schedule_bundles
 from .object_ref import ObjectRef
+from ..common import clock as _clk
 
 
 def ready_oid_for(pg_id: PlacementGroupID) -> ObjectID:
@@ -244,7 +244,7 @@ class PlacementGroupManager:
                     placed = self._place_many(recs) if recs else set()
                     self._pending = [rec.pg_id for rec in recs
                                      if rec.pg_id not in placed]
-            time.sleep(0.05)
+            _clk.sleep(0.05)
 
     # -- node death ---------------------------------------------------------
     def on_node_removed(self, row: int) -> None:

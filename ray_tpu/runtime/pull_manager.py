@@ -24,13 +24,13 @@ from __future__ import annotations
 import enum
 import heapq
 import threading
-import time
 from collections import deque
 
 import numpy as np
 
 from ..common.config import get_config
 from ..common.ids import ObjectID
+from ..common import clock as _clk
 
 
 class PullPriority(enum.IntEnum):
@@ -243,7 +243,7 @@ class PullManager:
                     # source-selection round instead of surfacing a
                     # bogus permanent loss to the waiters
                     req["attempts"] = req.get("attempts", 0) + 1
-                    time.sleep(0.2 * req["attempts"])
+                    _clk.sleep(0.2 * req["attempts"])
                     with self._cv:
                         self._inflight_bytes -= req["size"]
                         dup = self._requests.get(key)
@@ -293,7 +293,7 @@ class PullManager:
         dest_addr = planes.get(dest)
         if src_addr is None and dest_addr is None:
             if self._sim_gbps > 0:
-                time.sleep(size / (self._sim_gbps * 1e9))
+                _clk.sleep(size / (self._sim_gbps * 1e9))
             return True
         plane = self._cluster.plane
         if src_addr is None:

@@ -22,7 +22,6 @@ resource release — the same wake set as the reference's asio event loop.
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 
 import numpy as np
@@ -37,6 +36,7 @@ from .object_ref import ObjectRef
 from .serialization import (RayTaskError, WorkerCrashedError, deserialize,
                             serialize)
 from .worker_pool import WorkerHandle, WorkerPool
+from ..common import clock as _clk
 
 
 class _ClassQueue:
@@ -292,7 +292,7 @@ class Raylet:
             self._local_queue.append(
                 task_id,
                 rec.spec.resources.key() if rec is not None else None)
-            self._local_since[task_id] = time.monotonic()
+            self._local_since[task_id] = _clk.monotonic()
             self._dirty = True
             self._cv.notify_all()
         if self._draining:
@@ -392,7 +392,7 @@ class Raylet:
                     # liveness pong: every wake (including health pings via
                     # _notify_dirty) re-stamps — a wedged batch or a dead
                     # thread stops the stamps and the health manager sees it
-                    self._last_pong = time.monotonic()
+                    self._last_pong = _clk.monotonic()
                     if self._stopped or (self._dirty and
                                          (self._queue or self._local_queue)):
                         break
@@ -409,7 +409,7 @@ class Raylet:
                 self._dirty = False
                 batch = list(self._queue)
                 self._queue.clear()
-            round_t0 = time.monotonic()
+            round_t0 = _clk.monotonic()
             try:
                 self._reconcile_assigned()
                 # the timed wake must ALSO run the stale-lease recall:
@@ -432,7 +432,7 @@ class Raylet:
                 self._drain_local()
                 if batch:
                     self._round_durations.append(
-                        time.monotonic() - round_t0)
+                        _clk.monotonic() - round_t0)
             except Exception:   # noqa: BLE001 — one bad batch must not
                 # kill the node's scheduling thread (every later task
                 # would hang); the batch's tasks are lost to this round
@@ -1078,7 +1078,7 @@ class Raylet:
                             self._env_miss_since.pop(task_id, None)
                             self._planned_add(spec.resources, -1)
                             target.assigned.append(
-                                (task_id, time.monotonic()))
+                                (task_id, _clk.monotonic()))
                             self._assigned_total += 1
                             committed = True
                     if committed:
@@ -1186,7 +1186,7 @@ class Raylet:
         # lineage budget cost, measured here where the args are already
         # serialized (complete() must not re-pickle under the manager lock)
         rec.lineage_bytes = len(payload) + 256
-        self._task_start[spec.task_id.binary()] = time.time()
+        self._task_start[spec.task_id.binary()] = _clk.now()
         worker.leased_task = spec.task_id.binary()
         worker.leased_streaming = spec.num_returns == -1
         with self._cv:
@@ -1259,7 +1259,7 @@ class Raylet:
             # reuse), but tasks that rendezvous with each other (a
             # barrier under a job-level env) hold their workers, and
             # only growing the cache un-deadlocks them
-            now = time.monotonic()
+            now = _clk.monotonic()
             grace = get_config().env_worker_grace_ms / 1000.0
             with self._cv:
                 first = self._env_miss_since.setdefault(task_id, now)
@@ -1322,7 +1322,7 @@ class Raylet:
         raylet).  Tasks with in-flight arg pulls stay (they are making
         progress)."""
         timeout = get_config().worker_lease_timeout_ms / 1000.0
-        now = time.monotonic()
+        now = _clk.monotonic()
         moved = []
         multi_node = len(self.cluster.raylets) > 1
         with self._cv:
@@ -1483,7 +1483,7 @@ class Raylet:
                 with self._cv:
                     self._local_queue.append(task_id,
                                              rec.spec.resources.key())
-                    self._local_since[task_id] = time.monotonic()
+                    self._local_since[task_id] = _clk.monotonic()
                     self._planned_add(rec.spec.resources, 1)
         if spill:
             self._notify_dirty()
@@ -1601,7 +1601,7 @@ class Raylet:
                              "span_id": rec.spec.task_id.hex()}
                 self.cluster.events.span(
                     "task", rec.spec.function_descriptor[:16], t0,
-                    time.time(), self.row, worker=worker.proc.pid,
+                    _clk.now(), self.row, worker=worker.proc.pid,
                     status=kind, **trace)
             if rec is not None and not rec.done:
                 # returns seal BEFORE complete(): a dropped ref whose

@@ -12,11 +12,11 @@ mount empty).
 from __future__ import annotations
 
 import threading
-import time
 
 from ..common.ids import ObjectID
 from ..common.task_spec import TaskType
 from .object_ref import ObjectRef
+from ..common import clock as _clk
 
 
 class ObjectRecoveryManager:
@@ -52,10 +52,10 @@ class ObjectRecoveryManager:
         rec = self._cluster.task_manager.get(object_id.task_id())
         if rec is None:
             return
-        deadline = time.monotonic() + 2.0
+        deadline = _clk.monotonic() + 2.0
         while (not rec.done and self._cluster.store.contains(object_id)
-               and time.monotonic() < deadline):
-            time.sleep(0.0005)
+               and _clk.monotonic() < deadline):
+            _clk.sleep(0.0005)
 
     def _recover_locked(self, object_id: ObjectID) -> bool:
         if object_id.is_put():

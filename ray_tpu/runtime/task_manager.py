@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from ..common.config import get_config
 from ..common.ids import ObjectID, TaskID
 from ..common.task_spec import TaskSpec
+from ..common import clock as _clk
 
 
 @dataclass
@@ -231,9 +232,8 @@ class TaskManager:
         stream was never opened or already reaped (closed + done) —
         consumers distinguish a one-shot stream consumed elsewhere from
         a legitimately empty one."""
-        import time
         deadline = None if timeout is None else \
-            time.monotonic() + timeout
+            _clk.monotonic() + timeout
         with self._stream_cv:
             while True:
                 st = self._streams.get(task_id)
@@ -242,7 +242,7 @@ class TaskManager:
                 if st.sealed > index or st.done:
                     return st.sealed, st.done, st.error, True
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - _clk.monotonic()
                     if remaining <= 0:
                         return st.sealed, st.done, st.error, True
                     self._stream_cv.wait(remaining)

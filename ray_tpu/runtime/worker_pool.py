@@ -19,6 +19,7 @@ from collections import deque
 from typing import Callable
 
 from .worker import worker_main
+from ..common import clock as _clk
 
 # env vars that would make a spawned worker grab or re-register the TPU
 _SCRUB_ENV = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
@@ -331,11 +332,10 @@ class WorkerPool:
 
     def wait_ready(self, count: int = 1, timeout: float = 60.0) -> bool:
         """Block until at least ``count`` workers signalled ready."""
-        import time
-        deadline = time.monotonic() + timeout
+        deadline = _clk.monotonic() + timeout
         with self._cv:
             while sum(h.ready and not h.dead for h in self._workers) < count:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - _clk.monotonic()
                 if remaining <= 0:
                     return False
                 self._cv.wait(remaining)

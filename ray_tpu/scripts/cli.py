@@ -46,8 +46,8 @@ def _resolve_address(explicit: str | None) -> str:
 
 
 def _client(address: str | None):
-    from ..rpc import RpcClient
-    return RpcClient(_resolve_address(address))
+    from ..rpc import transport as _transport
+    return _transport.connect(_resolve_address(address))
 
 
 # -- subcommands -------------------------------------------------------------
@@ -159,8 +159,8 @@ def cmd_stop(args) -> int:
     except SystemExit:
         print("no running cluster")
         return 0
-    from ..rpc import RpcClient
-    client = RpcClient(resolved)
+    from ..rpc import transport as _transport
+    client = _transport.connect(resolved)
     try:
         client.call("stop_daemon", timeout=10.0)
         print("cluster stopping")
@@ -310,9 +310,9 @@ def cmd_chaos(args) -> int:
     # every chaos op is idempotent (set replaces, partition/heal are
     # set ops, status/trace read) — retry so the control plane stays
     # usable against the very fault injection it is steering
-    from ..rpc import RpcClient
-    client = RpcClient(_resolve_address(args.address),
-                       retryable=frozenset({"chaos"}))
+    from ..rpc import transport as _transport
+    client = _transport.connect(_resolve_address(args.address),
+                                retryable=frozenset({"chaos"}))
     try:
         out = client.call("chaos", op, **kw, timeout=30.0)
     finally:
@@ -432,6 +432,39 @@ def cmd_job(args) -> int:
     finally:
         client.close()
     return 0
+
+
+def cmd_simulate(args) -> int:
+    """``ray_tpu simulate`` — run a scripted chaos campaign on the
+    in-process cluster simulator (``ray_tpu/sim/``): N simulated nodes'
+    control planes on a virtual clock, faults injected from seeded
+    Philox streams, invariants checked after every event.  Same seed ⇒
+    identical trace hash; ``--verify-replay`` proves it inline."""
+    from ..sim import run_campaign
+
+    def _run(out=None):
+        return run_campaign(
+            args.nodes, seed=args.seed, campaign=args.campaign,
+            faults=args.faults, duration=args.duration,
+            autoscale=not args.no_autoscale, out=out,
+            progress=lambda msg: print(f"  {msg}", file=sys.stderr))
+
+    result = _run(out=args.out)
+    summary = result.to_dict()
+    if args.out:
+        print(f"trace artifact: {args.out}", file=sys.stderr)
+    if args.verify_replay:
+        replay = _run()
+        summary["replay_hash"] = replay.trace_hash
+        summary["replay_matches"] = \
+            replay.trace_hash == result.trace_hash
+        if not summary["replay_matches"]:
+            summary["violations"].append(
+                "replay hash mismatch: the campaign is not "
+                "deterministic")
+    print(json.dumps(summary, indent=2))
+    return 0 if summary.get("ok") and \
+        summary.get("replay_matches", True) else 1
 
 
 def cmd_lint(args) -> int:
@@ -652,6 +685,35 @@ def build_parser() -> argparse.ArgumentParser:
     pb.add_argument("--num-tasks", type=int, default=2000)
     pb.set_defaults(fn=cmd_microbenchmark)
 
+    from ..sim.campaign import CAMPAIGNS as _campaigns
+    psim = sub.add_parser(
+        "simulate",
+        help="run a chaos campaign on the in-process cluster simulator "
+             "(virtual clock, seeded faults, invariant checks after "
+             "every event); same seed reproduces the identical trace "
+             "hash")
+    psim.add_argument("--nodes", type=int, default=1000,
+                      help="simulated cluster size (default 1000)")
+    psim.add_argument("--seed", type=int, default=0,
+                      help="Philox seed: keys the job load, the fault "
+                           "schedule and every chaos link stream")
+    psim.add_argument("--campaign", choices=_campaigns, default="mixed")
+    psim.add_argument("--faults", type=int, default=50,
+                      help="scheduled fault draws (heals/restarts ride "
+                           "along; default 50)")
+    psim.add_argument("--duration", type=float, default=None,
+                      help="virtual seconds of chaos before quiesce "
+                           "(default max(180, 4*faults))")
+    psim.add_argument("--out", default=None, metavar="PATH",
+                      help="write the replayable trace artifact "
+                           "(ray_tpu-sim-trace/1 JSON)")
+    psim.add_argument("--verify-replay", action="store_true",
+                      help="run the campaign twice and fail unless the "
+                           "trace hashes match")
+    psim.add_argument("--no-autoscale", action="store_true",
+                      help="disable the simulated autoscaler loop")
+    psim.set_defaults(fn=cmd_simulate)
+
     plint = sub.add_parser(
         "lint",
         help="concurrency & invariant analyzer (rtlint): blocking-"
@@ -660,7 +722,7 @@ def build_parser() -> argparse.ArgumentParser:
     plint.add_argument("--format", choices=("text", "json"),
                        default="text")
     plint.add_argument("--rules", default=None,
-                       help="comma-separated subset of W1,W2,W3,W4")
+                       help="comma-separated subset of W1,W2,W3,W4,W5")
     plint.add_argument("--update-baseline", action="store_true",
                        help="accept current findings into "
                             "tools/rtlint/baseline.json")

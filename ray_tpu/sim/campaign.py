@@ -1,0 +1,334 @@
+"""Scripted chaos campaigns over the simulated cluster.
+
+A campaign is a deterministic schedule — built up-front from one Philox
+generator keyed by the seed — of background job load plus injected
+faults: rolling SIGKILLs, asymmetric partitions, gray-slow links,
+drain-under-churn, autoscaler flapping.  The runner executes it on the
+virtual clock, checks every invariant after every injected event, then
+quiesces (heal everything, restart a dead head, let recovery finish)
+and applies the strict final check: every acked job SUCCEEDED.
+
+Every run emits a replayable trace artifact keyed by seed: re-running
+``ray_tpu simulate`` with the same (nodes, seed, campaign, faults,
+duration) reproduces the identical event trace, asserted by comparing
+the sha256 trace hash.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+
+from ..rpc.chaos import _Params
+from ..rpc.client import RpcConnectionError
+from .cluster import HEAD_ADDR, SimCluster, SimParams
+from .invariants import check_invariants
+
+__all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
+           "build_schedule"]
+
+CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
+             "drain_churn", "autoscaler_flap")
+
+_SETTLE_CAP_S = 900.0       # virtual budget for the quiesce phase
+
+
+@dataclass
+class CampaignResult:
+    nodes: int
+    seed: int
+    campaign: str
+    faults_injected: int
+    jobs_acked: int
+    jobs_completed: int
+    events_fired: int
+    invariant_checks: int
+    violations: list = field(default_factory=list)
+    trace_hash: str = ""
+    virtual_s: float = 0.0
+    wall_s: float = 0.0
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "nodes": self.nodes, "seed": self.seed,
+            "campaign": self.campaign,
+            "faults_injected": self.faults_injected,
+            "jobs_acked": self.jobs_acked,
+            "jobs_completed": self.jobs_completed,
+            "events_fired": self.events_fired,
+            "invariant_checks": self.invariant_checks,
+            "violations": list(self.violations),
+            "trace_hash": self.trace_hash,
+            "virtual_s": round(self.virtual_s, 3),
+            "wall_s": round(self.wall_s, 3),
+            "events_per_sec": round(
+                self.events_fired / max(self.wall_s, 1e-9)),
+            "ok": self.ok,
+            "stats": self.stats,
+        }
+
+
+def _node_addr(idx: int) -> str:
+    return f"sim://n{idx:05d}"
+
+
+def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
+                   duration: float) -> tuple[list, list]:
+    """Deterministic (jobs, faults) schedules.  ``jobs`` is
+    ``(t, jid, {tid: duration})``; ``faults`` is ``(t, op, kwargs)``,
+    time-sorted with ties broken by build order.  All draws come from
+    ``rng`` in a fixed order, so the schedule is a pure function of
+    (campaign, seed, nodes, faults, duration)."""
+    if campaign not in CAMPAIGNS:
+        raise ValueError(f"unknown campaign {campaign!r}; "
+                         f"choose from {', '.join(CAMPAIGNS)}")
+    jobs = []
+    n_jobs = max(8, min(400, num_nodes // 4))
+    for k in range(n_jobs):
+        t = float(rng.uniform(1.0, duration * 0.7))
+        n_tasks = int(rng.integers(2, 9))
+        jid = f"job{k:04d}"
+        tasks = {f"{jid}.t{i}": round(float(rng.uniform(2.0, 18.0)), 3)
+                 for i in range(n_tasks)}
+        jobs.append((t, jid, tasks))
+    jobs.sort(key=lambda e: e[0])
+
+    # fault mix per campaign archetype (weights over op kinds)
+    mixes = {
+        "mixed": (("kill_node", 0.3), ("partition", 0.25),
+                  ("gray_slow", 0.15), ("drain", 0.2),
+                  ("kill_head", 0.1)),
+        "rolling_kill": (("kill_node", 0.9), ("kill_head", 0.1)),
+        "partitions": (("partition", 0.85), ("kill_head", 0.15)),
+        "gray_slow": (("gray_slow", 0.8), ("partition", 0.2)),
+        "drain_churn": (("drain", 0.7), ("kill_node", 0.3)),
+        "autoscaler_flap": (("drain", 0.4), ("kill_node", 0.4),
+                            ("gray_slow", 0.2)),
+    }
+    ops, weights = zip(*mixes[campaign])
+    sched = []
+    window = (duration * 0.05, duration * 0.85)
+    head_kills = 0
+    for _ in range(faults):
+        t = float(rng.uniform(*window))
+        u = float(rng.random())
+        acc, op = 0.0, ops[-1]
+        for name, w in zip(ops, weights):
+            acc += w
+            if u < acc:
+                op = name
+                break
+        target = int(rng.integers(0, num_nodes))
+        heal_after = float(rng.uniform(8.0, 25.0))
+        if op == "kill_head":
+            if head_kills >= 2:     # bounded: restarts must not overlap
+                op = "kill_node"
+            else:
+                head_kills += 1
+                sched.append((t, "kill_head", {}))
+                sched.append((t + heal_after, "restart_head", {}))
+                continue
+        if op == "partition":
+            kind = int(rng.integers(0, 3))
+            addr = _node_addr(target)
+            if kind == 0:       # asymmetric: head cannot reach node
+                pairs = [(HEAD_ADDR, addr)]
+            elif kind == 1:     # asymmetric: node cannot reach head
+                pairs = [(addr, HEAD_ADDR)]
+            else:               # full bidirectional cut
+                pairs = [(HEAD_ADDR, addr), (addr, HEAD_ADDR)]
+            sched.append((t, "partition", {"pairs": pairs}))
+            sched.append((t + heal_after, "heal", {"pairs": pairs}))
+            continue
+        if op == "gray_slow":
+            addr = _node_addr(target)
+            sched.append((t, "gray_slow", {"addr": addr}))
+            sched.append((t + heal_after, "gray_heal", {"addr": addr}))
+            continue
+        sched.append((t, op, {"node": f"n{target:05d}"}))
+    sched.sort(key=lambda e: e[0])
+    return jobs, sched
+
+
+def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
+                 faults: int = 50, duration: float | None = None,
+                 params: SimParams | None = None,
+                 autoscale: bool = True, lock_order: bool = False,
+                 out: str | None = None, progress=None) -> CampaignResult:
+    """Execute one campaign; returns a :class:`CampaignResult` whose
+    ``trace_hash`` is the replay fingerprint."""
+    import numpy as np
+
+    if duration is None:
+        duration = max(180.0, faults * 4.0)
+    wall0 = time.perf_counter()
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old_limit, 20000))
+
+    rng = np.random.Generator(np.random.Philox(
+        key=[int(seed) & (2 ** 64 - 1), 0xC0FFEE]))
+    jobs, sched = build_schedule(campaign, rng, num_nodes, faults,
+                                 duration)
+
+    cluster = SimCluster(num_nodes, seed=seed, params=params)
+    if lock_order:
+        from ..common import lockorder
+        if not lockorder.installed():
+            lockorder.install()
+    acked: list[str] = []
+    completed_cache = {"n": 0}
+    fault_count = {"n": 0}
+    inv_checks = {"n": 0}
+    violations: list[str] = []
+    clock, trace = cluster.clock, cluster.trace
+    driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+
+    def submit(jid, tasks, attempt=0):
+        try:
+            if driver.call("job_submit", jid, tasks) == "ack":
+                acked.append(jid)
+                return
+        except RpcConnectionError:
+            pass
+        if attempt < 40:        # head may be down: keep retrying
+            clock.call_later(3.0, lambda: submit(jid, tasks,
+                                                 attempt + 1))
+
+    def check(stage):
+        v, n = check_invariants(cluster, acked)
+        inv_checks["n"] += n
+        trace.rec(clock.monotonic(), "invariant_check", stage=stage,
+                  checks=n, violations=len(v))
+        for msg in v:
+            if len(violations) < 100:
+                violations.append(f"[{stage}] {msg}")
+
+    def apply_fault(op, kw):
+        t = clock.monotonic()
+        if op == "kill_head":
+            cluster.kill_head()
+            trace.rec(t, "fault", op=op)
+        elif op == "restart_head":
+            if cluster.head is None:
+                cluster.start_head()
+            trace.rec(t, "fault", op=op)
+        elif op == "kill_node":
+            hit = cluster.kill_node(kw["node"])
+            trace.rec(t, "fault", op=op, node=kw["node"], hit=hit)
+        elif op == "drain":
+            ok = False
+            if cluster.head is not None and cluster.head.alive:
+                ok = cluster.head.start_drain(kw["node"], "campaign")
+            trace.rec(t, "fault", op=op, node=kw["node"], hit=ok)
+        elif op == "partition":
+            for pair in kw["pairs"]:
+                cluster.chaos.partitions.add(tuple(pair))
+            trace.rec(t, "fault", op=op, pairs=kw["pairs"])
+        elif op == "heal":
+            for pair in kw["pairs"]:
+                cluster.chaos.partitions.discard(tuple(pair))
+            trace.rec(t, "fault", op=op, pairs=kw["pairs"])
+        elif op == "gray_slow":
+            cluster.chaos.links[kw["addr"]] = _Params(
+                drop_p=0.25, dup_p=0.05, delay_p=0.9, delay_ms=350.0)
+            trace.rec(t, "fault", op=op, addr=kw["addr"])
+        elif op == "gray_heal":
+            cluster.chaos.links.pop(kw["addr"], None)
+            trace.rec(t, "fault", op=op, addr=kw["addr"])
+        fault_count["n"] += 1
+        check(f"after:{op}")
+
+    try:
+        with cluster:
+            if autoscale:
+                cluster.enable_autoscaler(
+                    min_nodes=num_nodes,
+                    max_nodes=num_nodes + max(8, num_nodes // 10))
+            for t, jid, tasks in jobs:
+                clock.call_later(
+                    t, lambda jid=jid, tasks=tasks: submit(jid, tasks))
+            for t, op, kw in sched:
+                clock.call_later(
+                    t, lambda op=op, kw=kw: apply_fault(op, kw))
+
+            clock.run_until(duration)
+            if progress:
+                progress(f"campaign phase done at t={duration:.0f}s "
+                         f"virtual, {fault_count['n']} faults")
+
+            # -- quiesce: heal the world, let recovery converge ----------
+            cluster.chaos.partitions.clear()
+            cluster.chaos.links.clear()
+            if cluster.head is None:
+                cluster.start_head()
+            trace.rec(clock.monotonic(), "quiesce")
+
+            def all_done():
+                head = cluster.head
+                if head is None or not head.alive:
+                    return False
+                done = sum(1 for jid in acked
+                           if head.jobs.get(jid, {}).get("status") ==
+                           "succeeded")
+                completed_cache["n"] = done
+                return done == len(acked)
+
+            settle_end = duration + _SETTLE_CAP_S
+            while not all_done() and clock.monotonic() < settle_end:
+                clock.advance(cluster.params.heartbeat_period_s)
+            check("final")
+            v, n = check_invariants(cluster, acked, strict=True)
+            inv_checks["n"] += n
+            trace.rec(clock.monotonic(), "invariant_check",
+                      stage="final_strict", checks=n, violations=len(v))
+            for msg in v:
+                if len(violations) < 100:
+                    violations.append(f"[final] {msg}")
+            all_done()
+    finally:
+        cluster.close()
+        sys.setrecursionlimit(old_limit)
+
+    wall = time.perf_counter() - wall0
+    result = CampaignResult(
+        nodes=num_nodes, seed=int(seed), campaign=campaign,
+        faults_injected=fault_count["n"], jobs_acked=len(acked),
+        jobs_completed=completed_cache["n"],
+        events_fired=clock.fired, invariant_checks=inv_checks["n"],
+        violations=violations, trace_hash=trace.hash(),
+        virtual_s=clock.monotonic(), wall_s=wall,
+        stats=cluster.stats())
+    if out:
+        write_artifact(out, result, trace, duration, faults)
+    return result
+
+
+def write_artifact(path: str, result: CampaignResult, cluster_trace,
+                   duration: float | None, faults: int | None = None,
+                   extra: dict | None = None) -> None:
+    """The replayable trace artifact: seed + parameters reproduce the
+    run; the hash proves the reproduction matched.  ``replay`` holds
+    the exact ``run_campaign`` arguments (``faults`` is the *requested*
+    count — the schedule key — not the injected total)."""
+    doc = {
+        "format": "ray_tpu-sim-trace/1",
+        "replay": {"nodes": result.nodes, "seed": result.seed,
+                   "campaign": result.campaign, "faults": faults,
+                   "duration": duration},
+        "result": result.to_dict(),
+        "events_total": cluster_trace.total,
+        "events_stored": len(cluster_trace.events),
+        "events": cluster_trace.events,
+    }
+    if extra:
+        doc.update(extra)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")

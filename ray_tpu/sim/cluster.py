@@ -1,0 +1,757 @@
+"""Simulated control plane: head, nodes and autoscaler as discrete-event
+state machines.
+
+These are the *control* state machines of the real runtime — register/
+heartbeat/death declaration (``runtime/health.py``), lease grant and
+lost-ack requeue (``runtime/raylet.py``), the breaker→quarantine→
+soft-avoid chain (``rpc/breaker.py`` + ``runtime/health.py`` +
+scheduler), drain convergence (``cluster_utils.drain_node``), snapshot
+persistence and head failover (``runtime/head.py``), lineage
+reconstruction (``runtime/recovery.py``) and the autoscaler sizing loop
+— re-expressed over the ``Clock``/``Transport`` seams so 10k of them
+run in one process.  Where the real modules have a reusable primitive
+(``PeerBreaker``, the chaos plane's Philox link streams), the simulator
+uses the real class, on virtual time.
+
+Determinism contract: single-threaded, virtual clock, all randomness
+from Philox (the chaos instance plus the campaign's own generator), no
+iteration over unordered sets.  The same seed replays the same trace,
+byte for byte.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import deque
+from dataclasses import dataclass
+
+from ..common.clock import VirtualClock
+from ..common.config import get_config
+from ..rpc.breaker import CLOSED, OPEN, PeerBreaker
+from ..rpc.chaos import _Chaos
+from ..rpc.client import RpcConnectionError
+from .transport import SimTransport
+
+__all__ = ["SimCluster", "SimParams", "SimHead", "SimNode",
+           "SimAutoscaler", "Trace", "ALIVE", "DRAINING", "DEAD",
+           "REMOVED"]
+
+ALIVE, DRAINING, DEAD, REMOVED = "alive", "draining", "dead", "removed"
+HEAD_ADDR = "sim://head"
+
+_TRACE_EVENT_CAP = 20000        # stored events; the hash covers ALL
+
+
+class Trace:
+    """Append-only campaign trace with an incremental sha256 over the
+    canonical JSON of every event — the replay fingerprint.  Storage is
+    capped (artifacts stay small at 10k nodes); the hash is not."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+        self.total = 0
+        self._h = hashlib.sha256()
+
+    def rec(self, t: float, kind: str, **fields) -> None:
+        ev = {"t": round(t, 6), "kind": kind}
+        ev.update(fields)
+        self._h.update(json.dumps(
+            ev, sort_keys=True, separators=(",", ":")).encode())
+        self._h.update(b"\n")
+        self.total += 1
+        if len(self.events) < _TRACE_EVENT_CAP:
+            self.events.append(ev)
+
+    def hash(self) -> str:
+        return self._h.hexdigest()
+
+
+@dataclass
+class SimParams:
+    """Timing/shape knobs, defaulted from the ``sim_*`` config knobs."""
+
+    heartbeat_period_s: float = 5.0
+    miss_threshold: int = 3
+    lease_timeout_s: float = 20.0
+    drain_deadline_s: float = 45.0
+    node_capacity: int = 4
+    boot_delay_s: float = 3.0
+    autoscaler_interval_s: float = 5.0
+    autoscaler_idle_timeout_s: float = 60.0
+
+    @classmethod
+    def from_config(cls) -> "SimParams":
+        cfg = get_config()
+        return cls(
+            heartbeat_period_s=cfg.sim_heartbeat_period_s,
+            miss_threshold=cfg.sim_heartbeat_miss_threshold,
+            lease_timeout_s=cfg.sim_lease_timeout_s,
+            drain_deadline_s=cfg.sim_drain_deadline_s,
+            node_capacity=cfg.sim_node_capacity,
+            boot_delay_s=cfg.sim_boot_delay_s,
+        )
+
+
+class SimNode:
+    """One simulated node agent: heartbeat loop, lease execution with
+    idempotent re-grant handling, ack retry, drain participation."""
+
+    def __init__(self, cluster: "SimCluster", nid: str):
+        self.cluster = cluster
+        self.nid = nid
+        self.address = f"sim://{nid}"
+        self.clock = cluster.clock
+        self.params = cluster.params
+        self.alive = True
+        self.registered = False
+        self.draining = False
+        self.running: dict[str, float] = {}     # tid -> started (virtual)
+        self.done: dict[str, str] = {}          # tid -> oid (ack cache)
+        self.holds: dict[str, bool] = {}        # oid -> True
+        self.server = cluster.transport.serve(
+            {"exec": self._h_exec, "drain": self._h_drain,
+             "ping": self._h_ping}, host=self.address).start()
+        self.head = cluster.transport.connect(HEAD_ADDR,
+                                              _sim_src=self.address)
+
+    def start(self, stagger: float = 0.0) -> None:
+        self.clock.call_later(stagger, self._beat)
+
+    # -- heartbeat / (re-)register loop --------------------------------------
+    def _beat(self) -> None:
+        if not self.alive:
+            return
+        try:
+            if not self.registered:
+                self.head.call("register", self.nid, self.address,
+                               self._report())
+                self.registered = True
+            else:
+                reply = self.head.call("heartbeat", self.nid)
+                if reply == "reregister":
+                    # restarted head lost our row: rejoin with state
+                    self.registered = False
+                    self.head.call("register", self.nid, self.address,
+                                   self._report())
+                    self.registered = True
+        except RpcConnectionError:
+            pass        # head down/partitioned: keep beating
+        self.clock.call_later(self.params.heartbeat_period_s, self._beat)
+
+    def _report(self) -> dict:
+        return {"running": list(self.running), "done": dict(self.done),
+                "holds": list(self.holds), "draining": self.draining}
+
+    # -- handlers ------------------------------------------------------------
+    def _h_ping(self) -> str:
+        return "pong"
+
+    def _h_exec(self, tid: str, duration: float):
+        if tid in self.done:
+            # late re-grant of finished work: answer from the ack cache
+            return {"op": "done", "oid": self.done[tid]}
+        if tid in self.running:
+            return {"op": "running"}        # dup delivery: idempotent
+        if self.draining:
+            return {"op": "rejected"}
+        self.running[tid] = self.clock.monotonic()
+        self.clock.call_later(duration, lambda: self._complete(tid))
+        return {"op": "accepted"}
+
+    def _h_drain(self) -> str:
+        self.draining = True
+        if not self.running:
+            self._drain_done(0)
+        return "ok"
+
+    # -- completion / ack ----------------------------------------------------
+    def _complete(self, tid: str) -> None:
+        if not self.alive or tid not in self.running:
+            return
+        del self.running[tid]
+        oid = "o:" + tid
+        self.done[tid] = oid
+        if len(self.done) > 512:            # bounded idempotency window
+            self.done.pop(next(iter(self.done)))
+        self.holds[oid] = True
+        self._ack(tid, oid, 0)
+        if self.draining and not self.running:
+            self._drain_done(0)
+
+    def _ack(self, tid: str, oid: str, attempt: int) -> None:
+        if not self.alive:
+            return
+        try:
+            self.head.call("task_done", self.nid, tid, oid)
+        except RpcConnectionError:
+            self.clock.call_later(min(8.0, 1.0 + attempt),
+                                  lambda: self._ack(tid, oid, attempt + 1))
+
+    def _drain_done(self, attempt: int) -> None:
+        if not self.alive or not self.draining or self.running:
+            return
+        try:
+            self.head.call("drain_done", self.nid)
+        except RpcConnectionError:
+            self.clock.call_later(min(8.0, 1.0 + attempt),
+                                  lambda: self._drain_done(attempt + 1))
+            return
+        # drained and acknowledged: this node's process exits
+        self.alive = False
+        self.cluster.transport.kill(self.address)
+        self.cluster.node_stopped(self.nid)
+
+
+class SimHead:
+    """The simulated head: node table, job/lease tables, snapshot-backed
+    persistence (survives kill), death declaration, lost-ack lease
+    requeue, drain convergence, breaker-driven quarantine with
+    soft-avoid scheduling, and lineage reconstruction."""
+
+    def __init__(self, cluster: "SimCluster"):
+        self.cluster = cluster
+        self.clock = cluster.clock
+        self.params = cluster.params
+        self.trace = cluster.trace
+        self.persist = cluster.persist      # survives head kill
+        self.alive = True
+        self.nodes: dict[str, dict] = {}
+        self._node_order: list[str] = []
+        self._rr = 0
+        self.jobs: dict[str, dict] = {}
+        self.tasks: dict[str, dict] = {}
+        self.objects: dict[str, dict] = {}  # oid -> {producer, copies}
+        self.pending: deque[str] = deque()
+        self.breakers: dict[str, PeerBreaker] = {}
+        self._clients: dict[str, object] = {}
+        self.server = cluster.transport.serve(
+            {"register": self._h_register, "heartbeat": self._h_heartbeat,
+             "job_submit": self._h_job_submit, "task_done": self._h_task_done,
+             "drain_done": self._h_drain_done, "ping": self._h_ping,
+             "status": self._h_status}, host=HEAD_ADDR).start()
+        self._restore()
+        self.clock.call_later(self.params.heartbeat_period_s,
+                              self._monitor)
+
+    # -- persistence ---------------------------------------------------------
+    def _restore(self) -> None:
+        restored = 0
+        for jid, spec in self.persist["jobs"].items():
+            tids = list(spec["tasks"])
+            self.jobs[jid] = {"tasks": tids, "status": "running"}
+            for tid in tids:
+                done_oid = self.persist["done"].get(tid)
+                t = {"job": jid, "duration": spec["tasks"][tid],
+                     "state": "pending", "node": None, "granted_at": 0.0,
+                     "attempts": 0, "oid": None}
+                if done_oid is not None:
+                    t["state"] = "done"
+                    t["oid"] = done_oid
+                    self.objects.setdefault(
+                        done_oid, {"producer": tid, "copies": {}})
+                else:
+                    self.pending.append(tid)
+                self.tasks[tid] = t
+            self._refresh_job(jid)
+            restored += 1
+        if restored:
+            self.trace.rec(self.clock.monotonic(), "head_restore",
+                           jobs=restored, pending=len(self.pending))
+
+    # -- handlers ------------------------------------------------------------
+    def _h_ping(self) -> str:
+        return "pong"
+
+    def _h_register(self, nid: str, address: str, report: dict) -> str:
+        now = self.clock.monotonic()
+        known = nid in self.nodes
+        self.nodes[nid] = {
+            "address": address, "state": ALIVE, "last_hb": now,
+            "suspect": False, "running": {}, "drain_started": None,
+            "idle_since": now,
+        }
+        if not known:
+            self._node_order.append(nid)
+        row = self.nodes[nid]
+        if report.get("draining"):
+            row["state"] = DRAINING
+            row["drain_started"] = now
+        for tid, oid in report.get("done", {}).items():
+            self._mark_done(tid, oid, nid)
+        for oid in report.get("holds", ()):
+            obj = self.objects.get(oid)
+            if obj is not None:
+                obj["copies"][nid] = True
+        for tid in report.get("running", ()):
+            t = self.tasks.get(tid)
+            if t is not None and t["state"] != "done":
+                t["state"] = "running"
+                t["node"] = nid
+                t["granted_at"] = now
+                row["running"][tid] = True
+        self._schedule()
+        return "ok"
+
+    def _h_heartbeat(self, nid: str) -> str:
+        row = self.nodes.get(nid)
+        if row is None or row["state"] in (DEAD, REMOVED):
+            return "reregister"
+        row["last_hb"] = self.clock.monotonic()
+        return "ok"
+
+    def _h_job_submit(self, jid: str, tasks: dict) -> str:
+        if jid not in self.persist["jobs"]:
+            # persist BEFORE acking: an acked job survives a head kill
+            self.persist["jobs"][jid] = {"tasks": dict(tasks)}
+            self.jobs[jid] = {"tasks": list(tasks), "status": "running"}
+            for tid, duration in tasks.items():
+                self.tasks[tid] = {
+                    "job": jid, "duration": duration, "state": "pending",
+                    "node": None, "granted_at": 0.0, "attempts": 0,
+                    "oid": None}
+                self.pending.append(tid)
+            self.trace.rec(self.clock.monotonic(), "job_submit", job=jid,
+                           tasks=len(tasks))
+        self._schedule()
+        return "ack"
+
+    def _h_task_done(self, nid: str, tid: str, oid: str) -> str:
+        self._mark_done(tid, oid, nid)
+        self._schedule()
+        return "ok"
+
+    def _h_drain_done(self, nid: str) -> str:
+        row = self.nodes.get(nid)
+        if row is not None and row["state"] == DRAINING:
+            self._remove_node(nid, "drained")
+        return "ok"
+
+    def _h_status(self) -> dict:
+        states: dict[str, int] = {}
+        for nid in self._node_order:
+            row = self.nodes.get(nid)
+            if row is not None:
+                states[row["state"]] = states.get(row["state"], 0) + 1
+        return {"nodes": states, "jobs": len(self.jobs),
+                "pending": len(self.pending)}
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _mark_done(self, tid: str, oid: str, nid: str) -> None:
+        t = self.tasks.get(tid)
+        if t is None:
+            return
+        prev = t["node"]
+        if prev is not None:
+            prow = self.nodes.get(prev)
+            if prow is not None:
+                prow["running"].pop(tid, None)
+                if not prow["running"]:
+                    prow["idle_since"] = self.clock.monotonic()
+        nrow = self.nodes.get(nid)
+        if nrow is not None:
+            nrow["running"].pop(tid, None)
+            if not nrow["running"]:
+                nrow["idle_since"] = self.clock.monotonic()
+        obj = self.objects.setdefault(oid,
+                                      {"producer": tid, "copies": {}})
+        obj["copies"][nid] = True
+        if t["state"] != "done":
+            t["state"] = "done"
+            t["node"] = None
+            t["oid"] = oid
+            self.persist["done"][tid] = oid
+            self._refresh_job(t["job"])
+
+    def _refresh_job(self, jid: str) -> None:
+        job = self.jobs.get(jid)
+        if job is None or job["status"] == "succeeded":
+            return
+        if all(self.tasks[tid]["state"] == "done"
+               for tid in job["tasks"]):
+            job["status"] = "succeeded"
+            self.trace.rec(self.clock.monotonic(), "job_complete",
+                           job=jid)
+
+    def _breaker(self, addr: str) -> PeerBreaker:
+        b = self.breakers.get(addr)
+        if b is None:
+            cfg = get_config()
+            b = self.breakers[addr] = PeerBreaker(
+                addr, cfg.rpc_breaker_failure_threshold,
+                cfg.rpc_breaker_reset_s)
+        return b
+
+    def _client(self, nid: str):
+        c = self._clients.get(nid)
+        if c is None:
+            c = self._clients[nid] = self.cluster.transport.connect(
+                self.nodes[nid]["address"], _sim_src=HEAD_ADDR)
+        return c
+
+    def _after_breaker(self, nid: str, b: PeerBreaker) -> None:
+        """The quarantine chain: OPEN breaker -> suspect (scheduler
+        soft-avoids), CLOSED again -> unquarantined."""
+        row = self.nodes.get(nid)
+        if row is None:
+            return
+        if b.state == OPEN and not row["suspect"]:
+            row["suspect"] = True
+            self.trace.rec(self.clock.monotonic(), "quarantine",
+                           node=nid, opens=b.opens)
+        elif b.state == CLOSED and row["suspect"]:
+            row["suspect"] = False
+            self.trace.rec(self.clock.monotonic(), "unquarantine",
+                           node=nid)
+
+    # -- scheduling ----------------------------------------------------------
+    def _pick_node(self) -> str | None:
+        for allow_suspect in (False, True):     # soft-avoid: two passes
+            n = len(self._node_order)
+            for off in range(n):
+                nid = self._node_order[(self._rr + off) % n]
+                row = self.nodes.get(nid)
+                if row is None or row["state"] != ALIVE:
+                    continue
+                if row["suspect"] and not allow_suspect:
+                    continue
+                if len(row["running"]) >= self.params.node_capacity:
+                    continue
+                if row["suspect"] and \
+                        not self._breaker(row["address"]).allow():
+                    continue        # open breaker: hard fail-fast
+                self._rr = (self._rr + off + 1) % n
+                return nid
+        return None
+
+    def _schedule(self) -> None:
+        if not self.alive:
+            return
+        for _ in range(len(self.pending)):
+            if not self.pending:
+                break
+            tid = self.pending.popleft()
+            t = self.tasks.get(tid)
+            if t is None or t["state"] != "pending":
+                continue
+            nid = self._pick_node()
+            if nid is None:
+                self.pending.appendleft(tid)
+                break
+            self._grant(tid, nid)
+
+    def _grant(self, tid: str, nid: str) -> None:
+        row = self.nodes[nid]
+        b = self._breaker(row["address"])
+        t = self.tasks[tid]
+        try:
+            reply = self._client(nid).call("exec", tid, t["duration"])
+        except RpcConnectionError:
+            b.record_failure()
+            self._after_breaker(nid, b)
+            self.pending.append(tid)
+            return
+        b.record_success()
+        self._after_breaker(nid, b)
+        if reply.get("op") == "done":
+            self._mark_done(tid, reply["oid"], nid)
+            return
+        if reply.get("op") == "rejected":       # node started draining
+            self.pending.append(tid)
+            return
+        t["state"] = "running"
+        t["node"] = nid
+        t["granted_at"] = self.clock.monotonic()
+        t["attempts"] += 1
+        row["running"][tid] = True
+
+    # -- drain / death / removal ---------------------------------------------
+    def start_drain(self, nid: str, reason: str) -> bool:
+        row = self.nodes.get(nid)
+        if row is None or row["state"] != ALIVE:
+            return False
+        row["state"] = DRAINING
+        row["drain_started"] = self.clock.monotonic()
+        self.trace.rec(self.clock.monotonic(), "drain_start", node=nid,
+                       reason=reason)
+        try:
+            self._client(nid).call("drain")
+        except RpcConnectionError:
+            pass        # deadline in the monitor will force-remove
+        return True
+
+    def _on_node_dead(self, nid: str, reason: str) -> None:
+        row = self.nodes[nid]
+        row["state"] = DEAD
+        requeued = self._requeue_node(nid)
+        for oid in list(self.objects):
+            self.objects[oid]["copies"].pop(nid, None)
+        self.trace.rec(self.clock.monotonic(), "node_dead", node=nid,
+                       reason=reason, requeued=requeued)
+        self._remove_node(nid, "dead")
+
+    def _requeue_node(self, nid: str) -> int:
+        row = self.nodes[nid]
+        requeued = 0
+        for tid in list(row["running"]):
+            t = self.tasks.get(tid)
+            if t is not None and t["state"] == "running" and \
+                    t["node"] == nid:
+                t["state"] = "pending"
+                t["node"] = None
+                self.pending.append(tid)
+                requeued += 1
+        row["running"].clear()
+        return requeued
+
+    def _remove_node(self, nid: str, reason: str) -> None:
+        row = self.nodes[nid]
+        if row["state"] != DEAD:
+            self._requeue_node(nid)
+        row["state"] = REMOVED
+        row["drain_started"] = None
+        self.trace.rec(self.clock.monotonic(), "node_removed", node=nid,
+                       reason=reason)
+
+    # -- the periodic monitor ------------------------------------------------
+    def _monitor(self) -> None:
+        if not self.alive:
+            return
+        now = self.clock.monotonic()
+        p = self.params
+        hb_deadline = p.heartbeat_period_s * p.miss_threshold
+        for nid in self._node_order:
+            row = self.nodes.get(nid)
+            if row is None:
+                continue
+            state = row["state"]
+            if state in (ALIVE, DRAINING) and \
+                    now - row["last_hb"] > hb_deadline:
+                self._on_node_dead(nid, "heartbeat_timeout")
+                continue
+            if state == DRAINING and row["drain_started"] is not None \
+                    and now - row["drain_started"] > p.drain_deadline_s:
+                self._remove_node(nid, "drain_deadline")
+                continue
+            # lost-ack lease recovery
+            for tid in list(row["running"]):
+                t = self.tasks.get(tid)
+                if t is None or t["state"] != "running":
+                    row["running"].pop(tid, None)
+                    continue
+                if now - t["granted_at"] > p.lease_timeout_s:
+                    row["running"].pop(tid, None)
+                    t["state"] = "pending"
+                    t["node"] = None
+                    self.pending.append(tid)
+                    self.trace.rec(now, "lease_requeued", task=tid,
+                                   node=nid)
+            # half-open probes for quarantined nodes
+            if row["state"] == ALIVE and row["suspect"]:
+                b = self._breaker(row["address"])
+                if b.allow():
+                    try:
+                        self._client(nid).call("ping")
+                        b.record_success()
+                    except RpcConnectionError:
+                        b.record_failure()
+                    self._after_breaker(nid, b)
+        # lineage: outputs of done tasks that lost every copy while the
+        # job still needs them are reconstructed by re-running the task
+        for jid, job in self.jobs.items():
+            if job["status"] == "succeeded":
+                continue
+            for tid in job["tasks"]:
+                t = self.tasks[tid]
+                if t["state"] == "done":
+                    obj = self.objects.get(t["oid"])
+                    if obj is None or not obj["copies"]:
+                        t["state"] = "pending"
+                        t["node"] = None
+                        self.pending.append(tid)
+                        self.trace.rec(now, "reconstruct", task=tid,
+                                       job=jid)
+        self._schedule()
+        self.clock.call_later(p.heartbeat_period_s, self._monitor)
+
+
+class SimAutoscaler:
+    """Sizing loop over the simulated head's node table: launches to
+    cover pending demand and the min floor, drains idle surplus."""
+
+    def __init__(self, cluster: "SimCluster", min_nodes: int,
+                 max_nodes: int):
+        self.cluster = cluster
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.launched = 0
+        self.drained = 0
+        cluster.clock.call_later(cluster.params.autoscaler_interval_s,
+                                 self._tick)
+
+    def _tick(self) -> None:
+        cl = self.cluster
+        if not cl.running:
+            return
+        head = cl.head
+        if head is not None and head.alive:
+            p = cl.params
+            now = cl.clock.monotonic()
+            alive = []
+            free = 0
+            for nid in head._node_order:
+                row = head.nodes.get(nid)
+                if row is not None and row["state"] == ALIVE:
+                    alive.append(nid)
+                    if not row["suspect"]:
+                        free += p.node_capacity - len(row["running"])
+            pending = len(head.pending)
+            up = 0
+            if pending > free:
+                up = -(-(pending - free) // p.node_capacity)  # ceil
+            if len(alive) < self.min_nodes:
+                up = max(up, self.min_nodes - len(alive))
+            up = max(0, min(up, self.max_nodes - len(alive)))
+            if up:
+                for _ in range(up):
+                    cl.launch_node(booting=True)
+                self.launched += up
+                cl.trace.rec(now, "scale_up", count=up,
+                             pending=pending)
+            elif pending == 0 and len(alive) > self.min_nodes:
+                surplus = len(alive) - self.min_nodes
+                drained = 0
+                for nid in alive:
+                    if drained >= min(2, surplus):  # gentle: <=2/tick
+                        break
+                    row = head.nodes[nid]
+                    if not row["running"] and \
+                            now - row["idle_since"] > \
+                            p.autoscaler_idle_timeout_s:
+                        if head.start_drain(nid, "idle_surplus"):
+                            drained += 1
+                self.drained += drained
+        cl.clock.call_later(cl.params.autoscaler_interval_s, self._tick)
+
+
+class SimCluster:
+    """Owns the virtual clock, the sim transport, the chaos instance and
+    every simulated component.  ``install()``/``close()`` swap the
+    process clock seam in and out (the campaign runner brackets runs
+    with them)."""
+
+    def __init__(self, num_nodes: int, seed: int = 0,
+                 params: SimParams | None = None,
+                 chaos_params: dict | None = None):
+        self.seed = int(seed)
+        self.clock = VirtualClock()
+        self.params = params or SimParams.from_config()
+        self.chaos = _Chaos(seed=self.seed, **(chaos_params or {}))
+        self.transport = SimTransport(chaos=self.chaos)
+        self.trace = Trace()
+        self.persist: dict = {"jobs": {}, "done": {}}
+        self.nodes: dict[str, SimNode] = {}
+        self._next_node = 0
+        self.alive_count = 0
+        self.peak_nodes = 0
+        self.running = True
+        self.head: SimHead | None = None
+        self.autoscaler: SimAutoscaler | None = None
+        self.start_head()
+        period = self.params.heartbeat_period_s
+        for i in range(num_nodes):
+            # stagger first beats across one period so 10k registrations
+            # don't land on a single timestamp
+            self.launch_node(stagger=period * i / max(1, num_nodes))
+        self.trace.rec(0.0, "cluster_start", nodes=num_nodes,
+                       seed=self.seed)
+
+    # -- clock seam management ----------------------------------------------
+    def install(self) -> "SimCluster":
+        from ..common import clock as _clk
+        self._prev_clock = _clk.get_clock()
+        _clk.install(self.clock)
+        return self
+
+    def close(self) -> None:
+        from ..common import clock as _clk
+        self.running = False
+        if getattr(self, "_prev_clock", None) is not None:
+            _clk.install(self._prev_clock)
+            self._prev_clock = None
+        else:
+            _clk.uninstall()
+
+    def __enter__(self) -> "SimCluster":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- topology ------------------------------------------------------------
+    def start_head(self) -> SimHead:
+        self.head = SimHead(self)
+        return self.head
+
+    def kill_head(self) -> None:
+        if self.head is not None:
+            self.head.alive = False
+            self.transport.kill(HEAD_ADDR)
+            self.head = None
+
+    def launch_node(self, stagger: float | None = None,
+                    booting: bool = False) -> str:
+        nid = f"n{self._next_node:05d}"
+        self._next_node += 1
+        delay = self.params.boot_delay_s if booting else (stagger or 0.0)
+        if booting:
+            self.clock.call_later(delay, lambda: self._boot(nid, 0.0))
+        else:
+            self._boot(nid, delay)
+        return nid
+
+    def _boot(self, nid: str, stagger: float) -> None:
+        if not self.running:
+            return
+        node = SimNode(self, nid)
+        self.nodes[nid] = node
+        node.start(stagger=stagger)
+        self.alive_count += 1
+        self.peak_nodes = max(self.peak_nodes, self.alive_count)
+
+    def kill_node(self, nid: str) -> bool:
+        node = self.nodes.get(nid)
+        if node is None or not node.alive:
+            return False
+        node.alive = False
+        self.transport.kill(node.address)
+        self.alive_count -= 1
+        return True
+
+    def node_stopped(self, nid: str) -> None:
+        """A node exited cleanly (post-drain)."""
+        self.alive_count -= 1
+
+    def enable_autoscaler(self, min_nodes: int,
+                          max_nodes: int) -> SimAutoscaler:
+        self.autoscaler = SimAutoscaler(self, min_nodes, max_nodes)
+        return self.autoscaler
+
+    # -- convenience ---------------------------------------------------------
+    def alive_node_ids(self) -> list[str]:
+        return [nid for nid, n in self.nodes.items() if n.alive]
+
+    def stats(self) -> dict:
+        tr = self.transport
+        return {
+            "virtual_s": round(self.clock.monotonic(), 3),
+            "events_fired": self.clock.fired,
+            "rpc_calls": tr.calls,
+            "rpc_dropped": tr.dropped,
+            "rpc_dup": tr.dup_delivered,
+            "rpc_unreachable": tr.unreachable,
+            "chaos_partitioned": self.chaos.num_partitioned,
+            "chaos_delayed": self.chaos.num_delayed,
+            "peak_nodes": self.peak_nodes,
+            "trace_events": self.trace.total,
+        }

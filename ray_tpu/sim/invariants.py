@@ -1,0 +1,109 @@
+"""The invariants a campaign checks after every injected event.
+
+Each check returns a list of violation strings; the campaign runner
+fails (and the trace artifact records the violation) the moment one is
+non-empty.  Checks are grace-aware: a transiently broken state (a lease
+on a just-killed node, a drain mid-flight) is NOT a violation until the
+recovery machinery has had its deadline plus slack to act.  With the
+head down, head-derived checks are skipped (the campaign always
+restarts the head before the final strict pass).
+
+The five invariants, and the machinery each one proves:
+
+1. **no acked job lost** — persistence-before-ack + head restore
+2. **no lease stuck** — lost-ack lease requeue + death declaration
+3. **drains converge** — drain protocol + deadline force-removal
+4. **lineage reconstruction completes** — object-loss repair by
+   re-running producers (strict form: every acked job SUCCEEDED)
+5. **lock-order digraph stays acyclic** — the runtime lock-order
+   recorder (``common/lockorder.py``), when installed, over the real
+   locks the simulation exercises (chaos links, breakers)
+"""
+
+from __future__ import annotations
+
+__all__ = ["check_invariants"]
+
+
+def check_invariants(cluster, acked_jobs, strict: bool = False
+                     ) -> tuple[list[str], int]:
+    """Run every invariant; returns (violations, predicates_evaluated).
+
+    ``strict`` is the end-of-campaign form: every acked job must have
+    SUCCEEDED (which subsumes 'lineage reconstruction completes' — a
+    job whose lost outputs were never rebuilt cannot finish).
+    """
+    violations: list[str] = []
+    checks = 0
+    head = cluster.head
+    now = cluster.clock.monotonic()
+    p = cluster.params
+    grace = 2.0 * p.heartbeat_period_s
+
+    if head is not None and head.alive:
+        # 1. no acked job lost
+        for jid in acked_jobs:
+            checks += 1
+            if jid not in head.jobs:
+                violations.append(f"acked job lost: {jid}")
+        # 2. no lease stuck (monitor requeues at lease_timeout)
+        for nid in head._node_order:
+            row = head.nodes.get(nid)
+            if row is None:
+                continue
+            for tid in row["running"]:
+                t = head.tasks.get(tid)
+                if t is None or t["state"] != "running":
+                    continue
+                checks += 1
+                if now - t["granted_at"] > p.lease_timeout_s + grace:
+                    violations.append(
+                        f"lease stuck: {tid} on {nid} for "
+                        f"{now - t['granted_at']:.1f}s")
+            # 3. drains converge (deadline force-removal backstop)
+            if row["state"] == "draining":
+                checks += 1
+                started = row["drain_started"]
+                if started is not None and \
+                        now - started > p.drain_deadline_s + grace:
+                    violations.append(
+                        f"drain not converged: {nid} draining for "
+                        f"{now - started:.1f}s")
+        # 4. lineage: an output every incomplete job still needs must
+        # have a copy, or its producer must already be requeued/running
+        for jid, job in head.jobs.items():
+            if job["status"] == "succeeded":
+                continue
+            for tid in job["tasks"]:
+                t = head.tasks[tid]
+                if t["state"] != "done":
+                    continue        # pending/running == being rebuilt
+                checks += 1
+                obj = head.objects.get(t["oid"])
+                if (obj is None or not obj["copies"]) and strict:
+                    violations.append(
+                        f"lineage hole: {t['oid']} of {jid} has no "
+                        f"copies and producer {tid} is not requeued")
+        if strict:
+            for jid in acked_jobs:
+                checks += 1
+                job = head.jobs.get(jid)
+                if job is not None and job["status"] != "succeeded":
+                    n_done = sum(
+                        1 for tid in job["tasks"]
+                        if head.tasks[tid]["state"] == "done")
+                    violations.append(
+                        f"acked job incomplete after quiesce: {jid} "
+                        f"({n_done}/{len(job['tasks'])} tasks done)")
+
+    # 5. runtime lock-order digraph stays acyclic (when the recorder
+    # is armed — see rtlint_runtime_lock_order)
+    from ..common import lockorder
+    if lockorder.installed():
+        checks += 1
+        try:
+            lockorder.assert_acyclic()
+        except AssertionError as e:
+            violations.append(f"lock-order cycle: {e}")
+
+    return violations, checks

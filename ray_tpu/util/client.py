@@ -48,13 +48,13 @@ class ClientRuntime:
 
     def __init__(self, address: str, runtime_env: dict | None = None,
                  namespace: str | None = None):
-        from ..rpc import RpcClient
+        from ..rpc import transport as _transport
         self.address = address
         self.namespace = namespace or ""
         # idempotent head READS transparently retry on timeout/conn
         # loss (backoff + full jitter); mutations (submit/put/create)
         # never do — re-issuing those would double-execute
-        self._rpc = RpcClient(address, retryable=frozenset({
+        self._rpc = _transport.connect(address, retryable=frozenset({
             "ping", "status", "nodes", "available_resources",
             "cluster_resources", "list_named_actors",
             "get_actor_by_name", "job_status", "job_list", "job_logs",

@@ -50,6 +50,18 @@ def _fresh_fault_state():
 
 
 @pytest.fixture(autouse=True)
+def _real_seams():
+    """The clock and transport seams are process-global (like chaos):
+    a sim test that dies mid-campaign must not leave a VirtualClock or
+    SimTransport installed for the next (real-socket) test."""
+    from ray_tpu.common import clock
+    from ray_tpu.rpc import transport
+    yield
+    clock.uninstall()
+    transport.uninstall()
+
+
+@pytest.fixture(autouse=True)
 def _runtime_lock_order():
     """rtlint's dynamic mode: when the ``rtlint_runtime_lock_order``
     knob is on (RT_RTLINT_RUNTIME_LOCK_ORDER=1), every lock constructed

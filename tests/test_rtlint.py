@@ -616,3 +616,106 @@ class TestRuntimeLockOrder:
         assert any("ledger" not in a and "ledger" not in b or True
                    for (a, b) in lockorder.edges())
         assert len(lockorder.edges()) >= 1
+
+
+# -- W5: clock/transport seam discipline -------------------------------------
+
+class TestW5:
+    def _lint(self, tmp_path, relpath, source):
+        """W5 scopes by real package paths, so fixtures are written
+        under a throwaway ``ray_tpu/`` tree."""
+        target = tmp_path / relpath
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(source))
+        findings = analyzer.run_analysis(
+            str(tmp_path), package="ray_tpu", rules=("W5",),
+            files=[str(target)])
+        return [f for f in findings if f.rule != "E0"]
+
+    def test_fires_on_direct_time_in_runtime(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/runtime/mod.py", '''
+            import time
+            import time as _time
+            from time import sleep
+
+            def deadline():
+                return time.monotonic() + 5.0
+
+            def stamp():
+                return _time.time()
+
+            def pause():
+                sleep(0.1)
+
+            def legal():
+                return time.perf_counter()
+            ''')
+        details = sorted(f.detail for f in fs)
+        assert len(fs) == 3, details
+        assert any(d.startswith("clock:monotonic@deadline")
+                   for d in details), details
+        assert any(d.startswith("clock:time@stamp") for d in details)
+        assert any(d.startswith("clock:sleep@pause") for d in details)
+
+    def test_fires_on_direct_rpc_ctor_in_runtime(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/runtime/mod.py", '''
+            from ..rpc.client import RpcClient
+            from ..rpc.server import RpcServer
+
+            def make(addr):
+                c = RpcClient(addr)
+                s = RpcServer({})
+                return c, s
+            ''')
+        details = sorted(f.detail for f in fs)
+        assert len(fs) == 2, details
+        assert "transport:RpcClient@make" in details
+        assert "transport:RpcServer@make" in details
+
+    def test_quiet_when_routed_through_seams(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/runtime/mod.py", '''
+            from ..common import clock as _clk
+            from ..rpc import transport as _transport
+
+            def deadline():
+                return _clk.monotonic() + 5.0
+
+            def make(addr):
+                return _transport.connect(addr)
+            ''')
+        assert fs == []
+
+    def test_out_of_scope_and_suppressed_sites_quiet(self, tmp_path):
+        # outside runtime//rpc/: free to use wall time
+        fs = self._lint(tmp_path, "ray_tpu/serve/mod.py", '''
+            import time
+
+            def stamp():
+                return time.time()
+            ''')
+        assert fs == []
+        # rpc/ ctor use is the transport's own implementation detail
+        fs = self._lint(tmp_path, "ray_tpu/rpc/mod.py", '''
+            def make(addr):
+                return RpcClient(addr)
+            ''')
+        assert fs == []
+        # deliberate wall-clock site, visibly annotated
+        fs = self._lint(tmp_path, "ray_tpu/runtime/mod.py", '''
+            import time
+
+            def stamp():
+                return time.time()  # rtlint: disable=W5
+            ''')
+        assert fs == []
+
+    def test_live_package_w5_is_baselined_only(self):
+        """The seam audit itself: no NEW control-plane code bypasses
+        the clock/transport seams (worker-subprocess sites are the
+        explicit baseline)."""
+        new, based, stale, _ = analyzer.check(
+            REPO_ROOT, "ray_tpu", rules=("W5",),
+            baseline_path=os.path.join(REPO_ROOT, "tools", "rtlint",
+                                       "baseline.json"))
+        assert new == [], [f.format_text() for f in new]
+        assert all(f.path.endswith("runtime/worker.py") for f in based)

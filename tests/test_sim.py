@@ -1,0 +1,199 @@
+"""The in-process cluster simulator: determinism, recovery, chaos.
+
+Tier-1-fast campaigns assert the three load-bearing properties:
+
+1. a 64-node chaos campaign replays bit-for-bit (identical trace hash);
+2. a head kill mid-job recovers the job table from persistence — no
+   acked job is lost;
+3. an asymmetrically partitioned node walks the breaker → quarantine →
+   soft-avoid chain and heals, all under virtual time.
+
+The ``slow``-marked 2k-node campaign is the nightly tier.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.common.clock import VirtualClock
+from ray_tpu.rpc.client import RpcConnectionError
+from ray_tpu.sim import CAMPAIGNS, SimCluster, run_campaign
+from ray_tpu.sim.cluster import HEAD_ADDR
+from ray_tpu.sim.invariants import check_invariants
+
+
+# -- the virtual clock --------------------------------------------------------
+
+def test_virtual_clock_fires_in_time_then_seq_order():
+    clk = VirtualClock()
+    fired = []
+    clk.call_later(2.0, lambda: fired.append("b"))
+    clk.call_later(1.0, lambda: fired.append("a"))
+    clk.call_later(2.0, lambda: fired.append("c"))   # same t: after b
+    h = clk.call_later(1.5, lambda: fired.append("x"))
+    clk.cancel(h)
+    assert clk.advance(3.0) == 3
+    assert fired == ["a", "b", "c"]
+    assert clk.monotonic() == 3.0
+
+
+def test_virtual_sleep_inside_callback_advances_time():
+    clk = VirtualClock()
+    seen = []
+
+    def cb():
+        clk.sleep(5.0)
+        seen.append(clk.monotonic())
+
+    clk.call_later(1.0, cb)
+    clk.call_later(2.0, lambda: seen.append(clk.monotonic()))
+    clk.advance(10.0)
+    # the sleeper moved time to 6.0 and fired the t=2.0 timer en route
+    assert seen == [2.0, 6.0]
+
+
+# -- campaign determinism -----------------------------------------------------
+
+def test_64_node_campaign_replays_bit_for_bit():
+    kw = dict(seed=7, campaign="mixed", faults=12, duration=240.0)
+    r1 = run_campaign(64, **kw)
+    r2 = run_campaign(64, **kw)
+    assert r1.ok, r1.violations
+    assert r1.trace_hash == r2.trace_hash
+    assert r1.events_fired == r2.events_fired
+    assert r1.invariant_checks == r2.invariant_checks > 0
+    assert r1.jobs_completed == r1.jobs_acked > 0
+    assert r1.faults_injected >= 12
+
+
+def test_different_seeds_diverge():
+    r1 = run_campaign(64, seed=1, campaign="mixed", faults=8,
+                      duration=200.0)
+    r2 = run_campaign(64, seed=2, campaign="mixed", faults=8,
+                      duration=200.0)
+    assert r1.trace_hash != r2.trace_hash
+
+
+@pytest.mark.parametrize("campaign", CAMPAIGNS)
+def test_every_campaign_archetype_green(campaign):
+    r = run_campaign(48, seed=11, campaign=campaign, faults=8,
+                     duration=200.0)
+    assert r.ok, (campaign, r.violations)
+    assert r.jobs_completed == r.jobs_acked
+
+
+def test_trace_artifact_format(tmp_path):
+    out = tmp_path / "trace.json"
+    r = run_campaign(32, seed=5, campaign="rolling_kill", faults=6,
+                     duration=180.0, out=str(out))
+    doc = json.loads(out.read_text())
+    assert doc["format"] == "ray_tpu-sim-trace/1"
+    assert doc["replay"] == {"nodes": 32, "seed": 5,
+                             "campaign": "rolling_kill", "faults": 6,
+                             "duration": 180.0}
+    assert doc["result"]["trace_hash"] == r.trace_hash
+    assert doc["events_total"] == len(doc["events"])
+    assert doc["events"][0]["kind"] == "cluster_start"
+
+
+# -- head failover ------------------------------------------------------------
+
+def test_head_kill_mid_job_recovers_job_table():
+    cluster = SimCluster(8, seed=1)
+    with cluster:
+        driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+        cluster.clock.run_until(10.0)       # all 8 nodes registered
+        tasks = {f"j1.t{i}": 12.0 for i in range(6)}
+        assert driver.call("job_submit", "j1", tasks) == "ack"
+        cluster.clock.run_until(14.0)       # tasks granted, mid-flight
+        cluster.kill_head()
+        with pytest.raises(RpcConnectionError):
+            driver.call("ping")
+        cluster.clock.run_until(30.0)       # acks retry into the void
+        cluster.start_head()                # restore from persistence
+        cluster.clock.run_until(180.0)
+        head = cluster.head
+        assert "j1" in head.jobs            # the acked job survived
+        assert head.jobs["j1"]["status"] == "succeeded"
+        v, n = check_invariants(cluster, ["j1"], strict=True)
+        assert v == [] and n > 0
+        # the restore itself is on the trace
+        kinds = [e["kind"] for e in cluster.trace.events]
+        assert "head_restore" in kinds
+
+
+def test_node_kill_requeues_and_job_completes():
+    cluster = SimCluster(4, seed=3)
+    with cluster:
+        driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+        cluster.clock.run_until(10.0)
+        tasks = {f"j1.t{i}": 15.0 for i in range(8)}
+        assert driver.call("job_submit", "j1", tasks) == "ack"
+        cluster.clock.run_until(12.0)
+        assert cluster.kill_node("n00001")
+        cluster.clock.run_until(240.0)
+        head = cluster.head
+        assert head.jobs["j1"]["status"] == "succeeded"
+        kinds = [e["kind"] for e in cluster.trace.events]
+        assert "node_dead" in kinds         # declared via missed beats
+        assert check_invariants(cluster, ["j1"], strict=True)[0] == []
+
+
+# -- breaker -> quarantine -> soft-avoid -> heal ------------------------------
+
+def test_partitioned_node_quarantined_then_heals():
+    cluster = SimCluster(4, seed=2)
+    with cluster:
+        driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+        cluster.clock.run_until(10.0)
+        # asymmetric gray failure: head cannot reach n00001, but its
+        # heartbeats still arrive -- so it stays ALIVE, never DEAD
+        cluster.chaos.partitions.add((HEAD_ADDR, "sim://n00001"))
+        for k in range(10):     # steady load keeps grants flowing
+            driver.call("job_submit", f"j{k}",
+                        {f"j{k}.t{i}": 6.0 for i in range(4)})
+        cluster.clock.run_until(120.0)
+        ev = [(e["kind"], e.get("node")) for e in cluster.trace.events]
+        assert ("quarantine", "n00001") in ev
+        assert "node_dead" not in [k for k, _ in ev]
+        row = cluster.head.nodes["n00001"]
+        assert row["state"] == "alive" and row["suspect"]
+        # heal: the monitor's half-open ping probe closes the breaker
+        cluster.chaos.partitions.clear()
+        cluster.clock.run_until(240.0)
+        ev = [(e["kind"], e.get("node")) for e in cluster.trace.events]
+        assert ("unquarantine", "n00001") in ev
+        assert not cluster.head.nodes["n00001"]["suspect"]
+        acked = [f"j{k}" for k in range(10)]
+        assert check_invariants(cluster, acked, strict=True)[0] == []
+
+
+def test_drain_converges_and_node_exits():
+    cluster = SimCluster(4, seed=4)
+    with cluster:
+        driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+        cluster.clock.run_until(10.0)
+        driver.call("job_submit", "j1",
+                    {f"j1.t{i}": 8.0 for i in range(8)})
+        cluster.clock.run_until(12.0)
+        assert cluster.head.start_drain("n00002", "test")
+        cluster.clock.run_until(120.0)
+        ev = [(e["kind"], e.get("node")) for e in cluster.trace.events]
+        assert ("drain_start", "n00002") in ev
+        assert ("node_removed", "n00002") in ev
+        assert not cluster.nodes["n00002"].alive     # process exited
+        assert cluster.head.jobs["j1"]["status"] == "succeeded"
+        assert check_invariants(cluster, ["j1"], strict=True)[0] == []
+
+
+# -- nightly ------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_nightly_2k_node_campaign():
+    kw = dict(seed=13, campaign="mixed", faults=40, duration=400.0)
+    r1 = run_campaign(2000, **kw)
+    assert r1.ok, r1.violations
+    assert r1.jobs_completed == r1.jobs_acked
+    assert r1.faults_injected >= 40
+    r2 = run_campaign(2000, **kw)
+    assert r1.trace_hash == r2.trace_hash

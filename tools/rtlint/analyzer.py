@@ -6,10 +6,10 @@ import ast
 import os
 
 from . import baseline as baseline_mod
-from . import rules_knobs, rules_locks, rules_threads
+from . import rules_knobs, rules_locks, rules_threads, rules_time
 from .finding import Finding, sort_key
 
-ALL_RULES = ("W1", "W2", "W3", "W4")
+ALL_RULES = ("W1", "W2", "W3", "W4", "W5")
 
 
 class FileCtx:
@@ -81,6 +81,8 @@ def run_analysis(repo_root: str, package: str = "ray_tpu",
                 knob_strings |= strings
         if "W4" in rules:
             findings.extend(rules_threads.scan_file(ctx))
+        if "W5" in rules:
+            findings.extend(rules_time.scan_file(ctx))
 
     if "W1" in rules and lock_passes:
         findings.extend(rules_locks.interprocedural_w1(lock_passes))
