@@ -616,10 +616,17 @@ class Cluster:
     def route_local(self, row: int, task_id) -> bool:
         """Deliver a PLACED task into the target node's local dispatch
         queue (the task is scheduled exactly once)."""
+        return self.route_local_batch(row, [task_id])
+
+    def route_local_batch(self, row: int, task_ids: list) -> bool:
+        """Deliver a beat's whole per-node lease group in one call (the
+        fused schedule->lease->dispatch hand-off: no per-task boundary
+        crossing between the placement readback and the target's
+        dispatch queue)."""
         target = self.raylet_of_row(row)
         if target is None:
             return False
-        target.enqueue_local(task_id)
+        target.enqueue_local_batch(list(task_ids))
         return True
 
     # -- GCS persistence -----------------------------------------------------

@@ -69,6 +69,19 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Default is the break-even for a TUNNELED dev chip (~90 ms/call "
         "vs ~25 us/placement on CPU); drop to a few hundred when the TPU "
         "is host-local."),
+    "scheduler_delta_beats": (
+        bool, True,
+        "Incremental device heartbeat: keep the CRM mirror + carried key "
+        "tensor resident in HBM between beats and upload only the dirty "
+        "rows/classes (DeltaScheduler).  False re-uploads the full "
+        "snapshot every device round (the pre-delta behavior; parity "
+        "bisection)."),
+    "scheduler_delta_max_dirty_fraction": (
+        float, 0.25,
+        "Full-rescore fallback knob: when more than this fraction of "
+        "node rows changed since the last beat, the delta path costs "
+        "more than one bulk upload + full rescore, so the heartbeat "
+        "resyncs everything instead."),
     "scheduler_sharded_state": (
         bool, False,
         "Shard the device scheduler's cluster-state rows over ALL local "

@@ -84,16 +84,20 @@ def _launch_loop(type_caps, type_quotas, demand_reqs, remaining, max_iters):
 
 @jax.jit
 def autoscale(totals, avail, node_mask, demand_reqs, demand_counts,
-              type_caps, type_quotas):
+              type_caps, type_quotas, extra_mask=None):
     """Full demand-scheduler pass on device.
 
     totals/avail: (N, R) int32 cu existing nodes.  node_mask: (N,) bool.
     demand_reqs: (G, R) int32.  demand_counts: (G,) int32.
     type_caps: (K, R) int32.  type_quotas: (K,) int32.
+    extra_mask: optional (N,) bool beat-scoped node filter (suspect
+    soft-mask) ANDed into node_mask without re-uploading it.
 
     Returns (launches (K,), fit_counts (G, N+1), unmet (G,), new_avail).
     Bit-identical to autoscaler.demand.get_nodes_to_launch.
     """
+    if extra_mask is not None:
+        node_mask = node_mask & extra_mask
     G, N = demand_reqs.shape[0], totals.shape[0]
     gmasks = jnp.ones((G, N), dtype=bool)
     fit_counts, new_avail = schedule_grouped(
@@ -110,7 +114,7 @@ def autoscale(totals, avail, node_mask, demand_reqs, demand_counts,
 
 
 def autoscale_np(totals, avail, node_mask, demand_reqs, demand_counts,
-                 type_caps, type_quotas):
+                 type_caps, type_quotas, extra_mask=None):
     """Host wrapper: numpy in/out, device compute.
 
     Enforces the int32 width contract on node-type capacities: the launch
@@ -126,5 +130,6 @@ def autoscale_np(totals, avail, node_mask, demand_reqs, demand_counts,
         jnp.asarray(totals, jnp.int32), jnp.asarray(avail, jnp.int32),
         jnp.asarray(node_mask, bool), jnp.asarray(demand_reqs, jnp.int32),
         jnp.asarray(demand_counts, jnp.int32),
-        jnp.asarray(type_caps, jnp.int32), jnp.asarray(type_quotas, jnp.int32))
+        jnp.asarray(type_caps, jnp.int32), jnp.asarray(type_quotas, jnp.int32),
+        None if extra_mask is None else jnp.asarray(extra_mask, bool))
     return tuple(np.asarray(o) for o in out)

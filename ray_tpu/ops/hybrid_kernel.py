@@ -303,6 +303,105 @@ def schedule_group_host(avail, totals, node_mask, req, count,
     return counts_row, new_avail
 
 
+# -- delta-heartbeat kernels --------------------------------------------------
+#
+# The heartbeat keeps three residents in HBM between beats: the CRM mirror
+# (totals/avail/mask), the interned class request matrix ``reqs`` (C, R),
+# and the carried key tensor ``keys`` (C, N) — each class's packed placement
+# keys against every node, bit-identical to contract.compute_keys on the
+# mirror.  Per beat only the dirty slices move host->HBM and only the
+# touched key columns/rows re-score; a beat's placement decisions come back
+# in one fused counts readback (see scheduling.policy.DeltaScheduler).
+
+
+@jax.jit
+def full_rescore(totals, avail, mask, reqs, thr_fp):
+    """(C, N) carried key tensor: every resident scheduling class scored
+    against every node (vmapped device twin of contract.compute_keys)."""
+    return jax.vmap(
+        lambda r: _keys_one_req(totals, avail, r, thr_fp, mask))(reqs)
+
+
+def _keys_cols(totals, avail, mask, reqs, idx, thr_fp):
+    """Key columns for the B nodes in ``idx`` against all C classes —
+    the delta rescore costs (C, B) instead of (C, N)."""
+    t = totals[idx]                         # (B, R); padding idx clamps
+    a = avail[idx]
+    m = mask[idx]
+    req_pos = reqs > 0                      # (C, R)
+    feas = jnp.all(jnp.where(req_pos[:, None, :],
+                             t[None] >= reqs[:, None, :], True),
+                   axis=2) & m[None]        # (C, B)
+    availb = jnp.all(jnp.where(req_pos[:, None, :],
+                               a[None] >= reqs[:, None, :], True), axis=2)
+    denom = jnp.maximum(t, 1)[None]
+    q = t[None] - a[None] + reqs[:, None, :]
+    s = jnp.where(req_pos[:, None, :], (q * SCALE) // denom, 0).max(
+        axis=2, initial=0)
+    eff = jnp.where(availb & (s < thr_fp), 0, s)
+    key = ((~availb).astype(jnp.int32) << AVAIL_SHIFT) \
+        | (eff << SCORE_SHIFT) | idx.astype(jnp.int32)[None, :]
+    return jnp.where(feas, key, _INF_KEY)
+
+
+@jax.jit
+def apply_dirty_rows(totals, avail, mask, keys, reqs, idx,
+                     row_totals, row_avail, row_mask, thr_fp):
+    """Scatter B dirty node rows into the device mirror and re-score ONLY
+    the touched key columns.  ``idx`` entries == N are padding lanes
+    (the scatter drops them; their rescored columns are dropped too).
+    Returns (totals, avail, mask, keys)."""
+    totals = totals.at[idx].set(row_totals, mode="drop")
+    avail = avail.at[idx].set(row_avail, mode="drop")
+    mask = mask.at[idx].set(row_mask, mode="drop")
+    cols = _keys_cols(totals, avail, mask, reqs, idx, thr_fp)
+    keys = keys.at[:, idx].set(cols, mode="drop")
+    return totals, avail, mask, keys
+
+
+@jax.jit
+def apply_dirty_classes(totals, avail, mask, keys, reqs, idx, class_reqs,
+                        thr_fp):
+    """Install B new/changed scheduling classes (slots ``idx``; padding
+    == C) and re-score their full key rows.  Returns (reqs, keys)."""
+    reqs = reqs.at[idx].set(class_reqs, mode="drop")
+    rows = jax.vmap(
+        lambda r: _keys_one_req(totals, avail, r, thr_fp, mask))(class_reqs)
+    keys = keys.at[idx].set(rows, mode="drop")
+    return reqs, keys
+
+
+@partial(jax.jit, static_argnames=("require_available",))
+def fused_beat(totals, avail, mask, keys, reqs, class_slots, group_counts,
+               extra_mask, ov_idx, ov_avail, thr_fp,
+               require_available=False):
+    """One heartbeat against the resident mirror: per-beat ephemeral row
+    overrides (the raylet's planned-load debits), an extra soft mask
+    (suspect avoidance), the grouped water-fill, and the per-class argmin
+    of the carried key tensor — everything the host needs comes back in
+    ONE counts readback per beat, not one per class.
+
+    class_slots: (G,) int32 slots into ``reqs``.  ov_idx/ov_avail:
+    (B,) int32 rows + (B, R) int32 replacement avail rows applied for
+    this beat only (padding idx == N; the resident mirror is untouched).
+    Returns (counts (G, N+1) int32, argmin_rows (C,) int32)."""
+    avail_eff = avail.at[ov_idx].set(ov_avail, mode="drop")
+    mask_eff = mask & extra_mask
+    group_reqs = reqs[jnp.clip(class_slots, 0, reqs.shape[0] - 1)]
+    n = totals.shape[0]
+    ones = jnp.ones((n,), bool)
+
+    def step(av, xs):
+        req, count = xs
+        row, new_av = _schedule_group(av, totals, mask_eff, req, count,
+                                      ones, thr_fp, require_available)
+        return new_av, row
+
+    _, counts = jax.lax.scan(step, avail_eff, (group_reqs, group_counts))
+    amin = jnp.argmin(keys, axis=1).astype(jnp.int32)
+    return counts, amin
+
+
 def schedule_grouped_np(totals, avail, node_mask, group_reqs, group_counts,
                         group_masks=None, thr_fp=None, spread_threshold=None):
     """Convenience host wrapper: numpy in/out, device compute."""

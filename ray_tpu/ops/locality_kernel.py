@@ -40,11 +40,15 @@ from .hybrid_kernel import (_BIG, _INF_KEY, _keys_one_req,
 @jax.jit
 def schedule_grouped_localized(totals, avail, node_mask, group_reqs,
                                group_counts, group_masks, pref_rows,
-                               thr_fp):
+                               thr_fp, extra_mask=None):
     """Like ``schedule_grouped`` with per-group soft locality.
 
     pref_rows: (G,) int32 preferred node row per group, -1 = none.
+    extra_mask: optional (N,) bool beat-scoped node filter (suspect
+    soft-mask) ANDed into node_mask without re-uploading it.
     Returns (counts (G, N+1), new_avail)."""
+    if extra_mask is not None:
+        node_mask = node_mask & extra_mask
     n = totals.shape[0]
 
     def step(avail, xs):
@@ -74,7 +78,8 @@ def schedule_grouped_localized(totals, avail, node_mask, group_reqs,
 @partial(jax.jit, static_argnames=())
 def schedule_grouped_topk(totals, avail, node_mask, group_reqs,
                           group_counts, group_masks, thr_fp, k_abs,
-                          k_frac_num, k_frac_den, rng_key):
+                          k_frac_num, k_frac_den, rng_key,
+                          extra_mask=None):
     """Top-k contention spread on device (see module docstring).
 
     k per group = min(feasible, max(k_abs,
@@ -83,6 +88,8 @@ def schedule_grouped_topk(totals, avail, node_mask, group_reqs,
     consuming placements are capped by per-node availability (the host
     sampler likewise only subtracts from available nodes — tasks beyond
     capacity queue without consuming)."""
+    if extra_mask is not None:
+        node_mask = node_mask & extra_mask
     n = totals.shape[0]
 
     def step(carry, xs):
@@ -133,7 +140,8 @@ def schedule_grouped_topk(totals, avail, node_mask, group_reqs,
 def schedule_grouped_localized_np(totals, avail, node_mask, group_reqs,
                                   group_counts, pref_rows,
                                   group_masks=None, thr_fp=None,
-                                  spread_threshold=None):
+                                  spread_threshold=None,
+                                  extra_mask=None):
     from ..scheduling.contract import threshold_fp
     if thr_fp is None:
         thr_fp = threshold_fp(spread_threshold)
@@ -145,7 +153,8 @@ def schedule_grouped_localized_np(totals, avail, node_mask, group_reqs,
         jnp.asarray(node_mask, bool), jnp.asarray(group_reqs, jnp.int32),
         jnp.asarray(group_counts, jnp.int32),
         jnp.asarray(group_masks, bool),
-        jnp.asarray(pref_rows, jnp.int32), jnp.int32(thr_fp))
+        jnp.asarray(pref_rows, jnp.int32), jnp.int32(thr_fp),
+        None if extra_mask is None else jnp.asarray(extra_mask, bool))
     return np.asarray(counts), np.asarray(new_avail)
 
 
@@ -153,7 +162,7 @@ def schedule_grouped_topk_np(totals, avail, node_mask, group_reqs,
                              group_counts, seed, round_index,
                              group_masks=None, thr_fp=None,
                              spread_threshold=None, k_abs=1,
-                             k_frac=0.0):
+                             k_frac=0.0, extra_mask=None):
     from fractions import Fraction
 
     from ..scheduling.contract import threshold_fp
@@ -172,5 +181,6 @@ def schedule_grouped_topk_np(totals, avail, node_mask, group_reqs,
         jnp.asarray(group_masks, bool), jnp.int32(thr_fp),
         jnp.int32(max(int(k_abs), 1)),
         jnp.int32(frac.numerator), jnp.int32(max(frac.denominator, 1)),
-        rng_key)
+        rng_key,
+        None if extra_mask is None else jnp.asarray(extra_mask, bool))
     return np.asarray(counts), np.asarray(new_avail)

@@ -143,6 +143,9 @@ class Raylet:
         # failures): tells _schedule_rows a -1 deserves a full-cluster
         # fallback pass before parking the task
         self._suspect_softmask = False
+        # device-resident delta-heartbeat engine (lazy: only rounds that
+        # take the plain device path ever build one)
+        self._delta_engine = None
         self._stopped = False
         # DRAINING: no new leases commit here, running tasks finish;
         # the pool and event loop stay alive (unlike _stopped) so the
@@ -269,30 +272,47 @@ class Raylet:
         over-assign this node.  Plasma args not yet local are pulled at
         task-arg priority; dispatch waits for the copies (reference:
         DependencyManager asks the PullManager for task args)."""
+        self.enqueue_local_batch([task_id])
+
+    def enqueue_local_batch(self, task_ids: list[TaskID]) -> None:
+        """Batched placement hand-off: a beat's whole lease group for
+        this node lands with one record-lookup pass and ONE queue
+        critical section instead of a per-task boundary crossing (the
+        fused schedule->lease->dispatch path).  Semantics per task are
+        exactly ``enqueue_local``'s, drain-race bounce included."""
         if self._draining:
             # route_local raced the drain: back to global scheduling
-            self._enqueue(task_id)
+            for task_id in task_ids:
+                self._enqueue(task_id)
             return
-        rec = self.task_manager.get(task_id)
-        pulls = []
-        if rec is not None:
+        recs = self.task_manager.get_many(task_ids)
+        pulls_by_task: dict[TaskID, list] = {}
+        from .object_store import PLASMA_KINDS
+        for task_id, rec in zip(task_ids, recs):
+            if rec is None:
+                continue
+            pulls = []
             for a in rec.spec.args:
                 if isinstance(a, ObjectRef):
-                    from .object_store import PLASMA_KINDS
                     kind, size = self.store.plasma_info(a.id)
                     if kind in PLASMA_KINDS and \
                             not self.cluster.directory.has_location(
                                 a.id, self.row):
                         pulls.append((a.id, size))
-        with self._cv:
-            if rec is not None:
-                self._planned_add(rec.spec.resources, 1)
             if pulls:
-                self._pull_pending[task_id] = len(pulls)
-            self._local_queue.append(
-                task_id,
-                rec.spec.resources.key() if rec is not None else None)
-            self._local_since[task_id] = _clk.monotonic()
+                pulls_by_task[task_id] = pulls
+        with self._cv:
+            now = _clk.monotonic()
+            for task_id, rec in zip(task_ids, recs):
+                if rec is not None:
+                    self._planned_add(rec.spec.resources, 1)
+                if task_id in pulls_by_task:
+                    self._pull_pending[task_id] = len(
+                        pulls_by_task[task_id])
+                self._local_queue.append(
+                    task_id,
+                    rec.spec.resources.key() if rec is not None else None)
+                self._local_since[task_id] = now
             self._dirty = True
             self._cv.notify_all()
         if self._draining:
@@ -300,20 +320,22 @@ class Raylet:
             # routed here: bounce straight back to global scheduling so
             # the guarantee "zero new leases after drain_node" holds
             with self._cv:
-                if task_id in self._local_queue:
-                    self._local_queue.remove(task_id)
-                    self._local_since.pop(task_id, None)
-                    self._pull_pending.pop(task_id, None)
-                    if rec is not None:
-                        self._planned_add(rec.spec.resources, -1)
-                    self._queue.append(task_id)
-                    self._cv.notify_all()
-        if pulls:
+                for task_id, rec in zip(task_ids, recs):
+                    if task_id in self._local_queue:
+                        self._local_queue.remove(task_id)
+                        self._local_since.pop(task_id, None)
+                        self._pull_pending.pop(task_id, None)
+                        if rec is not None:
+                            self._planned_add(rec.spec.resources, -1)
+                        self._queue.append(task_id)
+                self._cv.notify_all()
+        if pulls_by_task:
             from .pull_manager import PullPriority
-            for oid, size in pulls:
-                self.cluster.pull_manager.request_pull(
-                    oid, size, self.row, PullPriority.TASK_ARG,
-                    callback=lambda _ok, t=task_id: self._pull_done(t))
+            for task_id, pulls in pulls_by_task.items():
+                for oid, size in pulls:
+                    self.cluster.pull_manager.request_pull(
+                        oid, size, self.row, PullPriority.TASK_ARG,
+                        callback=lambda _ok, t=task_id: self._pull_done(t))
 
     def _pull_done(self, task_id: TaskID) -> None:
         with self._cv:
@@ -604,10 +626,8 @@ class Raylet:
             prefs = [None] * len(specs)
         if avoids is None:
             avoids = [False] * len(specs)
-        snapshot = self._effective_snapshot()
-        totals, avail, mask = (snapshot.totals, snapshot.avail,
-                               snapshot.node_mask)
-        width = totals.shape[1]
+        cfg = get_config()
+        width = self.crm.arrays()[0].shape[1]
         groups: dict[tuple, int] = {}
         reqs: list[np.ndarray] = []
         counts: list[int] = []
@@ -628,7 +648,7 @@ class Raylet:
                 avoid_flags.append(bool(avoids[t]))
             counts[g] += 1
             task_group[t] = g
-        G, N = len(reqs), totals.shape[0]
+        G = len(reqs)
         # pad the class axis to a power-of-2 bucket: every distinct G would
         # otherwise be a fresh XLA compilation (SURVEY §7 hard part 3);
         # count-0 padding rows are no-ops in the water-fill
@@ -639,36 +659,55 @@ class Raylet:
         cnt_arr[:G] = counts
         pref_arr = np.full(Gp, -1, dtype=np.int32)
         pref_arr[:G] = pref_rows
-        gmask = np.ones((Gp, N), dtype=bool)
-        for g, av in enumerate(avoid_flags):
-            if av and 0 <= self.row < N:
-                gmask[g, self.row] = False
-        cfg = get_config()
         top_k = cfg.scheduler_top_k_fraction
         plain = (pref_arr < 0).all() and not any(avoid_flags)
-        if cfg.scheduler_sharded_state and plain and top_k == 0:
-            # host gmask: the sharded branch pads its node axis
-            counts_host = self._schedule_sharded(
-                totals, avail, mask, req_arr, cnt_arr, gmask)[:G]
-        elif top_k > 0:
-            counts_host = self._schedule_device_topk(
-                totals, avail, mask, req_arr, cnt_arr, gmask, pref_arr,
-                cfg)[:G]
-        elif plain:
-            counts_dev, _ = schedule_grouped(
-                jnp.asarray(totals), jnp.asarray(avail),
-                jnp.asarray(mask), jnp.asarray(req_arr),
-                jnp.asarray(cnt_arr), jnp.asarray(gmask),
-                jnp.int32(threshold_fp(None)))
-            counts_host = np.asarray(counts_dev)[:G]
+        if plain and top_k == 0 and not cfg.scheduler_sharded_state \
+                and cfg.scheduler_delta_beats:
+            # incremental heartbeat: no snapshot copy, no full upload —
+            # the resident mirror syncs from the CRM dirty journal and
+            # planned load rides along as per-beat avail overrides
+            counts_host = self._schedule_rows_delta(req_arr[:G],
+                                                    cnt_arr[:G])
+            N = counts_host.shape[1] - 1
         else:
-            from ..ops.locality_kernel import schedule_grouped_localized
-            counts_dev, _ = schedule_grouped_localized(
-                jnp.asarray(totals), jnp.asarray(avail),
-                jnp.asarray(mask), jnp.asarray(req_arr),
-                jnp.asarray(cnt_arr), jnp.asarray(gmask),
-                jnp.asarray(pref_arr), jnp.int32(threshold_fp(None)))
-            counts_host = np.asarray(counts_dev)[:G]
+            snapshot = self._effective_snapshot()
+            totals, avail, mask = (snapshot.totals, snapshot.avail,
+                                   snapshot.node_mask)
+            if totals.shape[1] != width:
+                # a resource column appeared between the width probe and
+                # the snapshot; dense vectors only append columns, so
+                # zero-padding the request rows is exact
+                wider = np.zeros((Gp, totals.shape[1]), dtype=np.int32)
+                wider[:, :width] = req_arr
+                req_arr = wider
+            N = totals.shape[0]
+            gmask = np.ones((Gp, N), dtype=bool)
+            for g, av in enumerate(avoid_flags):
+                if av and 0 <= self.row < N:
+                    gmask[g, self.row] = False
+            if cfg.scheduler_sharded_state and plain and top_k == 0:
+                # host gmask: the sharded branch pads its node axis
+                counts_host = self._schedule_sharded(
+                    totals, avail, mask, req_arr, cnt_arr, gmask)[:G]
+            elif top_k > 0:
+                counts_host = self._schedule_device_topk(
+                    totals, avail, mask, req_arr, cnt_arr, gmask,
+                    pref_arr, cfg)[:G]
+            elif plain:
+                counts_dev, _ = schedule_grouped(
+                    jnp.asarray(totals), jnp.asarray(avail),
+                    jnp.asarray(mask), jnp.asarray(req_arr),
+                    jnp.asarray(cnt_arr), jnp.asarray(gmask),
+                    jnp.int32(threshold_fp(None)))
+                counts_host = np.asarray(counts_dev)[:G]
+            else:
+                from ..ops.locality_kernel import schedule_grouped_localized
+                counts_dev, _ = schedule_grouped_localized(
+                    jnp.asarray(totals), jnp.asarray(avail),
+                    jnp.asarray(mask), jnp.asarray(req_arr),
+                    jnp.asarray(cnt_arr), jnp.asarray(gmask),
+                    jnp.asarray(pref_arr), jnp.int32(threshold_fp(None)))
+                counts_host = np.asarray(counts_dev)[:G]
         # expand (G, N+1) counts into per-task rows, class-internal order
         # node-row-ascending (tasks within a class are interchangeable)
         slots = [np.repeat(
@@ -682,6 +721,44 @@ class Raylet:
             rows.append(int(slots[g][cursor[g]]))
             cursor[g] += 1
         return rows
+
+    def _schedule_rows_delta(self, req_arr, cnt_arr) -> "np.ndarray":
+        """The fused delta-heartbeat placement call
+        (scheduling.policy.DeltaScheduler): the device mirror syncs
+        incrementally from the CRM's dirty journal; this node's view of
+        planned-but-undispatched load rides along as per-beat avail
+        overrides and suspect rows as a per-beat soft mask — both with
+        the exact ``_effective_snapshot`` arithmetic, so placements are
+        bit-identical to the snapshot path.  One counts readback per
+        beat.  Returns (G, N+1) int32 counts."""
+        from ..scheduling.policy import DeltaScheduler
+        eng = self._delta_engine
+        if eng is None:
+            eng = self._delta_engine = DeltaScheduler(self.crm)
+        _v, totals_f, avail_f, place_mask, _rows = self.crm.delta_view(-2)
+        # suspect soft-avoid, same healthy-survivor rule as
+        # _effective_snapshot (suspect is advisory, never hard)
+        self._suspect_softmask = False
+        extra = None
+        sus = self.crm.suspect_mask()
+        if sus.any():
+            n = min(sus.shape[0], place_mask.shape[0])
+            healthy = place_mask.copy()
+            healthy[:n] &= ~sus[:n]
+            if healthy.any():
+                extra = ~sus
+                self._suspect_softmask = True
+        overrides: dict[int, np.ndarray] = {}
+        for row, planned in self._planned_overrides(
+                avail_f.shape[1]).items():
+            if not 0 <= row < avail_f.shape[0]:
+                continue
+            w = min(avail_f.shape[1], planned.shape[0])
+            base = avail_f[row].astype(np.int64)
+            base[:w] = (base[:w] - planned[:w]).clip(-(2**30), 2**30)
+            overrides[row] = base.astype(np.int32)
+        return eng.beat(req_arr, cnt_arr, overrides=overrides,
+                        extra_mask=extra)
 
     def _schedule_device_topk(self, totals, avail, mask, req_arr,
                               cnt_arr, gmask, pref_arr,
@@ -799,13 +876,27 @@ class Raylet:
                 if healthy.any():
                     snapshot.node_mask = healthy
                     self._suspect_softmask = True
+        for row, planned in self._planned_overrides(
+                snapshot.avail.shape[1]).items():
+            w = min(snapshot.avail.shape[1], planned.shape[0])
+            snapshot.avail[row, :w] = (
+                snapshot.avail[row, :w].astype(np.int64) - planned[:w]
+            ).clip(-(2**30), 2**30).astype(np.int32)
+        return snapshot
+
+    def _planned_overrides(self, width: int) -> dict[int, np.ndarray]:
+        """Per-row planned-but-undispatched + agent-locally-running
+        debits (int64 cu vectors): the ephemeral load every placement
+        round subtracts — applied to the snapshot copy by
+        ``_effective_snapshot`` and as per-beat device overrides by
+        ``_schedule_rows_delta`` (identical arithmetic either way)."""
+        out: dict[int, np.ndarray] = {}
         for row, raylet in list(self.cluster.raylets.items()):
             planned = raylet.planned_snapshot()
             local = raylet.agent_local_cu
             if local:
                 vec = ResourceRequest.from_cu_dict(local).dense(
-                    self.crm.resource_index,
-                    snapshot.avail.shape[1]).astype(np.int64)
+                    self.crm.resource_index, width).astype(np.int64)
                 if planned is None:
                     planned = vec
                 else:
@@ -814,13 +905,9 @@ class Raylet:
                     merged[:planned.shape[0]] += planned
                     merged[:vec.shape[0]] += vec
                     planned = merged
-            if planned is None:
-                continue
-            w = min(snapshot.avail.shape[1], planned.shape[0])
-            snapshot.avail[row, :w] = (
-                snapshot.avail[row, :w].astype(np.int64) - planned[:w]
-            ).clip(-(2**30), 2**30).astype(np.int32)
-        return snapshot
+            if planned is not None:
+                out[row] = planned
+        return out
 
     def _locality_row(self, spec) -> int | None:
         """Node row holding the most bytes of the spec's plasma args, or
@@ -923,13 +1010,22 @@ class Raylet:
             return []
         rows = self._schedule_rows(recs)
         leftover: list[TaskID] = []
+        local_ids: list[TaskID] = []
+        remote: dict[int, list[TaskID]] = {}
         for rec, row in zip(recs, rows):
             if row < 0:
                 leftover.append(rec.spec.task_id)
             elif row == self.row:
-                self.enqueue_local(rec.spec.task_id)
-            elif not self.cluster.route_local(row, rec.spec.task_id):
-                leftover.append(rec.spec.task_id)   # target died: retry
+                local_ids.append(rec.spec.task_id)
+            else:
+                remote.setdefault(row, []).append(rec.spec.task_id)
+        # fused hand-off: the beat's placement readback becomes per-node
+        # lease groups delivered in one call per target raylet
+        if local_ids:
+            self.enqueue_local_batch(local_ids)
+        for row, ids in remote.items():
+            if not self.cluster.route_local_batch(row, ids):
+                leftover.extend(ids)            # target died: retry
         return leftover
 
     def _drain_local(self) -> None:

@@ -96,6 +96,13 @@ class TaskManager:
         with self._lock:
             return self._records.get(task_id)
 
+    def get_many(self, task_ids) -> list:
+        """Batch record lookup: one lock round-trip for a whole
+        placement beat's hand-off (the fused dispatch path).  Returns
+        one record-or-None per id, in order."""
+        with self._lock:
+            return [self._records.get(t) for t in task_ids]
+
     def complete(self, task_id: TaskID) -> TaskRecord | None:
         """Mark done and move the record into the lineage retention window
         (sized by ``lineage_pinning_memory_mb``); evicted records lose

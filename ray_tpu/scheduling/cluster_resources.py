@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -27,6 +28,16 @@ from ..common.ids import NodeID
 from ..common.resources import NodeResources, ResourceIndex, ResourceRequest
 from .contract import MAX_NODES
 from .oracle import ClusterState
+
+
+# Dirty-row journal depth.  At 8k nodes a full resync uploads every row, so
+# once more than this many mutations pile up between two heartbeats the
+# delta bookkeeping costs more than it saves — truncate and let the consumer
+# fall back to a full upload.
+_DIRTY_LOG_CAP = 8192
+# Interned dense-request vectors (scheduling classes are few; this cap only
+# guards against an adversarial stream of unique requests).
+_REQ_CACHE_CAP = 4096
 
 
 class ClusterResourceManager:
@@ -57,7 +68,39 @@ class ClusterResourceManager:
         self._row_of: dict[NodeID, int] = {}
         self._id_of: dict[int, NodeID] = {}
         self._labels: dict[int, dict[str, str]] = {}
-        self.version = 0          # bumped on every mutation (device re-sync)
+        self.version = 0          # epoch: bumped on every mutation
+        # -- delta-heartbeat bookkeeping (see delta_view) -------------------
+        # journal of (version, row) per mutation, bounded by _DIRTY_LOG_CAP;
+        # consumers synced before _log_floor / _struct_version must resync
+        self._dirty_log: deque[tuple[int, int]] = deque()
+        self._log_floor = 0
+        self._struct_version = 0  # last capacity/width growth epoch
+        # epoch-memoized read-only copies handed out by snapshot()/arrays()/
+        # delta_view(): (version, totals, avail, raw_mask, place_mask)
+        self._frozen: tuple | None = None
+        # interned dense request vectors: (req.key(), width) -> frozen vec
+        self._req_cache: dict[tuple, np.ndarray] = {}
+
+    # -- epoch / dirty tracking ---------------------------------------------
+    def _mark(self, row: int | None = None) -> None:
+        """Bump the epoch and journal the dirty row (caller holds _lock).
+
+        Every mutation funnels through here so a device-resident mirror
+        can ask "what changed since version V?" (delta_view) instead of
+        re-uploading the whole state each heartbeat."""
+        self.version += 1
+        if row is not None:
+            if len(self._dirty_log) >= _DIRTY_LOG_CAP:
+                self._log_floor = self._dirty_log.popleft()[0]
+            self._dirty_log.append((self.version, row))
+
+    def _mark_struct(self) -> None:
+        """Capacity or width grew: array shapes moved under every mirror,
+        so all of them must full-resync.  Caller holds _lock."""
+        self._mark()
+        self._struct_version = self.version
+        self._dirty_log.clear()
+        self._log_floor = self.version
 
     # -- registration -------------------------------------------------------
     def add_node(self, node_id: NodeID, resources: NodeResources) -> int:
@@ -76,7 +119,7 @@ class ClusterResourceManager:
             self._row_of[node_id] = row
             self._id_of[row] = node_id
             self._labels[row] = dict(resources.labels)
-            self.version += 1
+            self._mark(row)
             return row
 
     def remove_node(self, node_id: NodeID) -> None:
@@ -91,7 +134,7 @@ class ClusterResourceManager:
             self.node_mask[row] = False
             self.draining[row] = False
             self.suspect[row] = False
-            self.version += 1
+            self._mark(row)
 
     # -- drain lifecycle (ALIVE -> DRAINING -> removed) ---------------------
     def set_draining(self, node_id: NodeID, flag: bool = True) -> int | None:
@@ -103,7 +146,7 @@ class ClusterResourceManager:
                 return None
             if bool(self.draining[row]) != flag:
                 self.draining[row] = flag
-                self.version += 1
+                self._mark(row)
             return row
 
     def is_draining(self, row: int) -> bool:
@@ -124,7 +167,7 @@ class ClusterResourceManager:
             if 0 <= row < self._capacity and \
                     bool(self.suspect[row]) != flag:
                 self.suspect[row] = flag
-                self.version += 1
+                self._mark(row)
 
     def suspect_mask(self) -> np.ndarray:
         with self._lock:
@@ -162,9 +205,11 @@ class ClusterResourceManager:
         sus[:self._capacity] = self.suspect
         self.suspect = sus
         self._capacity = cap
+        self._mark_struct()
 
     def _col(self, name: str) -> int:
         col = self.resource_index.get_or_add(name)
+        grew = False
         while col >= self._r_slots:
             new = np.zeros((self._capacity, self._r_slots * 2), dtype=np.int32)
             new[:, :self._r_slots] = self.totals
@@ -173,15 +218,29 @@ class ClusterResourceManager:
             new_a[:, :self._r_slots] = self.avail
             self.avail = new_a
             self._r_slots *= 2
+            grew = True
+        if grew:
+            self._mark_struct()
         return col
 
     def _dense_req(self, req: ResourceRequest) -> np.ndarray:
         """Dense cu vector, growing the resource slots to cover the request
         (ResourceRequest.dense interns names but cannot grow our arrays).
-        Caller must hold self._lock (array growth replaces the arrays)."""
-        for name in req.cu():
-            self._col(name)
-        return req.dense(self.resource_index, self._r_slots)
+        Caller must hold self._lock (array growth replaces the arrays).
+
+        The vector of each scheduling class is interned once per
+        (request, width) and shared read-only across beats — heartbeats
+        stop re-densifying every class every time."""
+        vec = self._req_cache.get((req.key(), self._r_slots))
+        if vec is None:
+            for name in req.cu():
+                self._col(name)          # may grow width (changes the key)
+            vec = req.dense(self.resource_index, self._r_slots)
+            vec.setflags(write=False)
+            if len(self._req_cache) >= _REQ_CACHE_CAP:
+                self._req_cache.clear()
+            self._req_cache[(req.key(), self._r_slots)] = vec
+        return vec
 
     def intern_request(self, req: ResourceRequest) -> np.ndarray:
         """Public, lock-acquiring name interning + densification — the safe
@@ -198,7 +257,7 @@ class ClusterResourceManager:
                 return
             for name, cu in available_cu.items():
                 self.avail[row, self._col(name)] = cu
-            self.version += 1
+            self._mark(row)
 
     # -- allocation (used by the dispatch path) -----------------------------
     def subtract(self, row: int, req: ResourceRequest) -> bool:
@@ -207,7 +266,7 @@ class ClusterResourceManager:
             if (self.avail[row] < vec).any():
                 return False
             self.avail[row] -= vec
-            self.version += 1
+            self._mark(row)
             return True
 
     def force_subtract(self, row: int, req: ResourceRequest) -> None:
@@ -215,14 +274,14 @@ class ClusterResourceManager:
         on worker-unblock; the matching add_back rebalances)."""
         with self._lock:
             self.avail[row] -= self._dense_req(req)
-            self.version += 1
+            self._mark(row)
 
     def add_back(self, row: int, req: ResourceRequest) -> None:
         with self._lock:
             vec = self._dense_req(req)
             self.avail[row] = np.minimum(self.totals[row],
                                          self.avail[row] + vec)
-            self.version += 1
+            self._mark(row)
             self._freed.notify_all()
 
     def wait_subtract(self, row: int, req: ResourceRequest,
@@ -236,7 +295,7 @@ class ClusterResourceManager:
                 vec = self._dense_req(req)
                 if (self.avail[row] >= vec).all():
                     self.avail[row] -= vec
-                    self.version += 1
+                    self._mark(row)
                     return True
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -254,7 +313,7 @@ class ClusterResourceManager:
                 col = self._col(name)
                 self.totals[row, col] += cu
                 self.avail[row, col] += cu
-            self.version += 1
+            self._mark(row)
 
     def remove_shaped_resources(self, row: int, shaped_cu: dict[str, int]
                                 ) -> None:
@@ -263,22 +322,68 @@ class ClusterResourceManager:
                 col = self._col(name)
                 self.totals[row, col] = max(0, self.totals[row, col] - cu)
                 self.avail[row, col] = max(0, self.avail[row, col] - cu)
-            self.version += 1
+            self._mark(row)
 
     # -- views --------------------------------------------------------------
+    def _frozen_locked(self) -> tuple:
+        """Epoch-memoized read-only copies of the state arrays.  One set
+        of copies per epoch, shared by snapshot()/arrays()/delta_view():
+        unchanged beats stop re-copying three arrays per heartbeat.
+        Caller holds _lock."""
+        if self._frozen is None or self._frozen[0] != self.version:
+            totals = self.totals.copy()
+            avail = self.avail.copy()
+            raw_mask = self.node_mask.copy()
+            place_mask = self.node_mask & ~self.draining
+            for arr in (totals, avail, raw_mask, place_mask):
+                arr.setflags(write=False)
+            self._frozen = (self.version, totals, avail, raw_mask,
+                            place_mask)
+        return self._frozen
+
     def snapshot(self) -> ClusterState:
         """Copy-on-read snapshot for a scheduling round (pure-function
         discipline: policies never see live mutable state — SURVEY §4
         'every scheduling decision is testable without real distribution')."""
         with self._lock:
             # DRAINING rows are infeasible for every placement consumer
-            # (raylet rounds, pg bundles, autoscaler demand, trainer fit)
-            return ClusterState(self.totals.copy(), self.avail.copy(),
-                                self.node_mask & ~self.draining)
+            # (raylet rounds, pg bundles, autoscaler demand, trainer fit).
+            # Policies decrement state.avail in place, so each caller gets
+            # its own writable avail; totals/mask are shared frozen views.
+            _, totals, avail, _raw, place = self._frozen_locked()
+            return ClusterState(totals, avail.copy(), place)
 
     def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Read-only epoch-frozen (totals, avail, node_mask) for metric /
+        autoscaler reads — memoized by the epoch counter."""
         with self._lock:
-            return self.totals, self.avail, self.node_mask
+            _, totals, avail, raw, _place = self._frozen_locked()
+            return totals, avail, raw
+
+    def delta_view(self, since_version: int) -> tuple:
+        """Atomic "what changed since epoch V" view for device-resident
+        mirrors (the delta-scheduling heartbeat).
+
+        Returns ``(version, totals, avail, place_mask, dirty_rows)``.
+        The arrays are the shared read-only epoch copies (never mutate);
+        ``place_mask = node_mask & ~draining`` — the same placement mask
+        ``snapshot()`` hands every consumer.  ``dirty_rows`` is the set
+        of rows mutated in ``(since_version, version]``; ``None`` means
+        the journal cannot answer (first sync, journal truncated past
+        ``since_version``, or a capacity/width growth moved array shapes)
+        and the caller must re-upload everything."""
+        with self._lock:
+            v, totals, avail, _raw, place = self._frozen_locked()
+            rows: set[int] | None
+            if since_version >= v:
+                rows = set()
+            elif since_version < self._struct_version or \
+                    since_version < self._log_floor:
+                rows = None
+            else:
+                rows = {r for (ver, r) in self._dirty_log
+                        if ver > since_version}
+            return v, totals, avail, place, rows
 
     def row_of(self, node_id: NodeID) -> int | None:
         return self._row_of.get(node_id)

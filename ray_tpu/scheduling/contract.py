@@ -111,6 +111,21 @@ def compute_keys(totals: np.ndarray, avail: np.ndarray, req: np.ndarray,
     return key.astype(np.int32)
 
 
+def compute_keys_batch(totals: np.ndarray, avail: np.ndarray,
+                       reqs: np.ndarray, thr_fp: int,
+                       node_mask: np.ndarray | None = None) -> np.ndarray:
+    """Packed keys for a batch of class requests: (C, N) int32.
+
+    The host oracle twin of ``ops.hybrid_kernel.full_rescore`` — the
+    carried key tensor a ``DeltaScheduler`` keeps device-resident
+    between beats must equal this on the mirrored state, row for row
+    (the delta-sequence parity gate).
+    """
+    reqs = np.asarray(reqs, dtype=np.int64)
+    return np.stack([compute_keys(totals, avail, r, thr_fp, node_mask)
+                     for r in reqs])
+
+
 def unpack_key(key: int) -> tuple[int, int, int]:
     """(unavailable_bucket, eff_score, traversal_index) for debugging."""
     return (int(key) >> AVAIL_SHIFT,

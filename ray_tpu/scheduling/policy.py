@@ -17,6 +17,7 @@ property test (SURVEY §4 closing note).
 from __future__ import annotations
 
 import enum
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -208,6 +209,343 @@ class NodeLabelSchedulingPolicy(ISchedulingPolicy):
             scheduling_type=SchedulingType.HYBRID,
             spread_threshold=options.spread_threshold)
         return self._hybrid.schedule(state, req, fallback)
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= max(n, floor) — XLA compilation bucketing
+    (same discipline as the raylet's device batch path)."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+class DeltaScheduler:
+    """Device-resident delta-scheduling heartbeat engine.
+
+    Keeps three residents in HBM between beats: a mirror of the CRM's
+    dense state (totals/avail/placement mask), the interned scheduling
+    class request matrix, and a carried (classes x nodes) packed-key
+    tensor bit-identical to ``contract.compute_keys`` on the mirror.
+    Each ``beat``:
+
+    1. asks the CRM what changed since the last synced epoch
+       (``ClusterResourceManager.delta_view``), stages ONLY the dirty
+       rows host->HBM through one of two staging slots (double
+       buffering: beat N+1's upload enqueues while beat N's readback is
+       still in flight — dispatch is async, the host blocks only on the
+       consumed counts buffer), and re-scores only the touched key
+       columns (``ops.hybrid_kernel.apply_dirty_rows``);
+    2. falls back to a full re-upload + ``full_rescore`` when the dirty
+       fraction crosses ``scheduler_delta_max_dirty_fraction``, the
+       journal was truncated, array shapes grew, or the spread
+       threshold changed;
+    3. runs the fused water-fill + per-class argmin
+       (``ops.hybrid_kernel.fused_beat``) with this beat's ephemeral
+       avail overrides (planned-load debits) and soft mask (suspect
+       avoidance) — ONE counts readback per beat, not one per class.
+
+    Placements are advisory exactly like the snapshot path: the CRM
+    stays authoritative, commits happen through ``subtract`` at
+    dispatch, which marks the rows dirty for the next beat.  Counts are
+    bit-identical to ``schedule_grouped`` on a fresh snapshot — the
+    randomized delta-sequence oracle test holds delta path == full
+    rescore == CPU oracle.
+    """
+
+    def __init__(self, crm):
+        self._crm = crm
+        self._version = -2          # pre-first-sync sentinel (< any epoch)
+        self._thr: int | None = None
+        # device residents
+        self._totals = None
+        self._avail = None
+        self._mask = None
+        self._keys = None
+        self._reqs = None
+        self._ones = None           # resident all-true extra mask
+        self._n = 0                 # padded node axis
+        self._r = 0                 # padded resource axis
+        self._cap_c = 0             # padded class axis
+        self._n_real = 0
+        self._r_real = 0
+        # class slot registry (+ host copies to rebuild across resyncs)
+        self._slot_of: dict[bytes, int] = {}
+        self._class_host: dict[int, np.ndarray] = {}
+        self._free_slots: list[int] = []
+        self._next_slot = 0
+        # double-buffered staging: the previous beat's upload stays
+        # referenced until its transfer can no longer be in flight
+        self._stage: list = [None, None]
+        self._parity = 0
+        self._empty_ov = None
+        self._last_amin = None
+        self.stats = {"beats": 0, "delta_beats": 0, "full_rescores": 0,
+                      "clean_beats": 0, "rows_uploaded": 0,
+                      "classes_installed": 0}
+        # opt-in phase profiling (bench.py): inserts device syncs after
+        # every phase, so it DEFEATS the double-buffered overlap — never
+        # enable on the live dispatch path
+        self.profile = False
+        self.phase_ms = {"densify": 0.0, "h2d": 0.0, "score": 0.0,
+                         "argmin": 0.0, "readback": 0.0}
+
+    # -- public surface -----------------------------------------------------
+    def beat(self, group_reqs, group_counts, overrides=None,
+             extra_mask=None, require_available: bool = False,
+             spread_threshold: float | None = None) -> np.ndarray:
+        """Sync the mirror, schedule G classes, return (G, n+1) int32
+        counts (column n = infeasible/queued-nowhere), matching
+        ``hybrid_kernel.schedule_grouped`` on a fresh CRM snapshot.
+
+        ``overrides``: {row: int32 avail vector} applied for this beat
+        only (the raylet's planned-load debits).  ``extra_mask``: host
+        bool (n,) soft mask ANDed into the placement mask for this beat
+        (suspect avoidance) — the carried key tensor ignores it.
+        """
+        import jax
+
+        from ..common.config import get_config
+        from ..ops import hybrid_kernel as hk
+
+        thr = int(threshold_fp(spread_threshold))
+        v, totals, avail, place_mask, rows = \
+            self._crm.delta_view(self._version)
+        n_real, r_real = totals.shape
+        cfg = get_config()
+        resync = (rows is None or self._totals is None
+                  or thr != self._thr or n_real != self._n_real
+                  or r_real != self._r_real)
+        if not resync and rows and len(rows) > \
+                cfg.scheduler_delta_max_dirty_fraction * n_real:
+            # the fallback knob: 0.0 disables the delta path entirely
+            resync = True
+        if resync:
+            self._full_sync(totals, avail, place_mask, thr)
+            self.stats["full_rescores"] += 1
+        elif rows:
+            self._delta_sync(sorted(rows), totals, avail, place_mask, thr)
+            self.stats["delta_beats"] += 1
+            self.stats["rows_uploaded"] += len(rows)
+        else:
+            self.stats["clean_beats"] += 1
+        self._version = v
+        self.stats["beats"] += 1
+
+        t0 = time.perf_counter() if self.profile else 0.0
+        group_reqs = np.ascontiguousarray(
+            np.asarray(group_reqs, np.int32))
+        g = group_reqs.shape[0]
+        if group_reqs.shape[1] != self._r_real:
+            # caller densified at an older width; columns only ever
+            # append, so zero-padding to the mirror's width is exact
+            norm = np.zeros((g, self._r_real), np.int32)
+            w = min(self._r_real, group_reqs.shape[1])
+            norm[:, :w] = group_reqs[:, :w]
+            group_reqs = norm
+        slots = self._ensure_classes(group_reqs, thr)
+        gp = _bucket(g)
+        slots_p = np.full((gp,), self._cap_c, np.int32)
+        slots_p[:g] = slots
+        counts_p = np.zeros((gp,), np.int32)
+        counts_p[:g] = np.asarray(group_counts, np.int32)
+
+        ov = self._pack_overrides(overrides)
+        if extra_mask is None:
+            em = self._ones
+        else:
+            emp = np.zeros((self._n,), bool)
+            emp[:n_real] = np.asarray(extra_mask, bool)[:n_real]
+            em = jax.device_put(emp)
+        if self.profile:
+            self.phase_ms["densify"] += (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+
+        counts_d, amin_d = hk.fused_beat(
+            self._totals, self._avail, self._mask, self._keys, self._reqs,
+            jax.device_put(slots_p), jax.device_put(counts_p), em,
+            ov[0], ov[1], thr, require_available=require_available)
+        self._last_amin = amin_d
+        if self.profile:
+            counts_d.block_until_ready()    # rtlint: disable=W6
+            self.phase_ms["argmin"] += (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+        # the one sanctioned host<-device readback of the beat
+        counts = np.asarray(counts_d)
+        if self.profile:
+            self.phase_ms["readback"] += (time.perf_counter() - t0) * 1e3
+        return np.concatenate(
+            [counts[:g, :n_real], counts[:g, -1:]], axis=1)
+
+    def hit_rate(self) -> float:
+        """Fraction of beats served without a full re-upload/rescore."""
+        b = self.stats["beats"]
+        return 0.0 if not b else 1.0 - self.stats["full_rescores"] / b
+
+    def retire_class(self, req_vec) -> bool:
+        """Forget an interned scheduling class, freeing its slot (the
+        next new class reuses it and rewrites the key row)."""
+        key = np.ascontiguousarray(
+            np.asarray(req_vec, np.int32)).tobytes()
+        slot = self._slot_of.pop(key, None)
+        if slot is None:
+            return False
+        self._class_host.pop(slot, None)
+        self._free_slots.append(slot)
+        return True
+
+    def keys_row_host(self, req_vec) -> np.ndarray:
+        """Carried key row of one interned class vs the real nodes —
+        verification surface for the parity tests (deliberate
+        readback)."""
+        key = np.ascontiguousarray(
+            np.asarray(req_vec, np.int32)).tobytes()
+        row = np.asarray(self._keys[self._slot_of[key]])
+        return row[:self._n_real].astype(np.int64)
+
+    def peek_argmin(self, req_vec) -> int:
+        """Best node row for one class per the carried key tensor (the
+        lease-grant preview; deliberate readback)."""
+        key = np.ascontiguousarray(
+            np.asarray(req_vec, np.int32)).tobytes()
+        return int(np.asarray(self._last_amin)[self._slot_of[key]])
+
+    # -- sync internals -----------------------------------------------------
+    def _full_sync(self, totals, avail, mask, thr):
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        n_real, r_real = totals.shape
+        n = _bucket(n_real, 64)
+        r = _bucket(r_real)
+        if r_real != self._r_real and self._slot_of:
+            # width grew: re-key the registry at the new width (dense
+            # vectors only ever append columns, so zero-padding is exact)
+            rekeyed = {}
+            for slot, vec in list(self._class_host.items()):
+                nv = np.zeros((r_real,), np.int32)
+                nv[:vec.shape[0]] = vec
+                self._class_host[slot] = nv
+                rekeyed[nv.tobytes()] = slot
+            self._slot_of = rekeyed
+        ht = np.zeros((n, r), np.int32)
+        ht[:n_real, :r_real] = totals
+        ha = np.zeros((n, r), np.int32)
+        ha[:n_real, :r_real] = avail
+        hm = np.zeros((n,), bool)
+        hm[:n_real] = mask
+        t0 = time.perf_counter() if self.profile else 0.0
+        self._totals = jax.device_put(ht)
+        self._avail = jax.device_put(ha)
+        self._mask = jax.device_put(hm)
+        self._ones = jax.device_put(np.ones((n,), bool))
+        self._empty_ov = None
+        self._n, self._r = n, r
+        self._n_real, self._r_real = n_real, r_real
+        if self.profile:
+            jax.block_until_ready(self._avail)  # rtlint: disable=W6
+            self.phase_ms["h2d"] += (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+        self._rebuild_class_plane(thr, rescore=False)
+        self._keys = hk.full_rescore(self._totals, self._avail,
+                                     self._mask, self._reqs, thr)
+        if self.profile:
+            jax.block_until_ready(self._keys)   # rtlint: disable=W6
+            self.phase_ms["score"] += (time.perf_counter() - t0) * 1e3
+        self._thr = thr
+
+    def _delta_sync(self, rows, totals, avail, mask, thr):
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        t0 = time.perf_counter() if self.profile else 0.0
+        b = _bucket(len(rows))
+        idx = np.full((b,), self._n, np.int32)   # padding idx -> dropped
+        idx[:len(rows)] = rows
+        rt = np.zeros((b, self._r), np.int32)
+        ra = np.zeros((b, self._r), np.int32)
+        rm = np.zeros((b,), bool)
+        rt[:len(rows), :self._r_real] = totals[rows]
+        ra[:len(rows), :self._r_real] = avail[rows]
+        rm[:len(rows)] = mask[rows]
+        # double-buffered staging: enqueue into the free slot; no host
+        # block here — the transfer overlaps the previous beat's compute
+        staged = jax.device_put((idx, rt, ra, rm))
+        self._stage[self._parity] = staged
+        self._parity ^= 1
+        if self.profile:
+            jax.block_until_ready(staged)       # rtlint: disable=W6
+            self.phase_ms["h2d"] += (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+        self._totals, self._avail, self._mask, self._keys = \
+            hk.apply_dirty_rows(self._totals, self._avail, self._mask,
+                                self._keys, self._reqs, *staged, thr)
+        if self.profile:
+            jax.block_until_ready(self._keys)   # rtlint: disable=W6
+            self.phase_ms["score"] += (time.perf_counter() - t0) * 1e3
+
+    def _rebuild_class_plane(self, thr, rescore=True):
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        cap = _bucket(max(self._next_slot, 1))
+        hr = np.zeros((cap, self._r), np.int32)
+        for slot, vec in self._class_host.items():
+            hr[slot, :vec.shape[0]] = vec
+        self._cap_c = cap
+        self._reqs = jax.device_put(hr)
+        if rescore:
+            self._keys = hk.full_rescore(self._totals, self._avail,
+                                         self._mask, self._reqs, thr)
+
+    def _ensure_classes(self, group_reqs, thr) -> np.ndarray:
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        slots = np.empty((group_reqs.shape[0],), np.int32)
+        fresh: list[tuple[int, np.ndarray]] = []
+        for i, vec in enumerate(group_reqs):
+            key = vec.tobytes()
+            slot = self._slot_of.get(key)
+            if slot is None:
+                slot = self._free_slots.pop() if self._free_slots \
+                    else self._next_slot
+                if slot == self._next_slot:
+                    self._next_slot += 1
+                self._slot_of[key] = slot
+                self._class_host[slot] = vec.copy()
+                fresh.append((slot, vec))
+            slots[i] = slot
+        if fresh:
+            self.stats["classes_installed"] += len(fresh)
+            if max(s for s, _ in fresh) >= self._cap_c:
+                self._rebuild_class_plane(thr)   # class axis grew
+            else:
+                b = _bucket(len(fresh))
+                idx = np.full((b,), self._cap_c, np.int32)
+                vecs = np.zeros((b, self._r), np.int32)
+                for j, (slot, vec) in enumerate(fresh):
+                    idx[j] = slot
+                    vecs[j, :vec.shape[0]] = vec
+                self._reqs, self._keys = hk.apply_dirty_classes(
+                    self._totals, self._avail, self._mask, self._keys,
+                    self._reqs, jax.device_put(idx), jax.device_put(vecs),
+                    thr)
+        return slots
+
+    def _pack_overrides(self, overrides):
+        import jax
+        if not overrides:
+            if self._empty_ov is None:
+                idx = np.full((8,), self._n, np.int32)
+                av = np.zeros((8, self._r), np.int32)
+                self._empty_ov = jax.device_put((idx, av))
+            return self._empty_ov
+        b = _bucket(len(overrides))
+        idx = np.full((b,), self._n, np.int32)
+        av = np.zeros((b, self._r), np.int32)
+        for j, (row, vec) in enumerate(sorted(overrides.items())):
+            idx[j] = row
+            av[j, :len(vec)] = np.asarray(vec, np.int32)
+        return jax.device_put((idx, av))
 
 
 class CompositeSchedulingPolicy(ISchedulingPolicy):

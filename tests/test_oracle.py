@@ -204,3 +204,221 @@ class TestPolicies:
         req = np.array([cu(1)], dtype=np.int32)
         opts = SchedulingOptions(require_node_available=True)
         assert policy.schedule(st, req, opts) == -1
+
+def _churn_cluster(seed, n_nodes=24, n_classes=6, capacity=32):
+    """A live ClusterResourceManager + interned class batch for the
+    delta-sequence tests (the real mutation surface, not a synthetic
+    snapshot)."""
+    from ray_tpu.common.ids import NodeID
+    from ray_tpu.common.resources import NodeResources, ResourceRequest
+    from ray_tpu.scheduling import ClusterResourceManager
+
+    rng = np.random.default_rng(seed)
+    crm = ClusterResourceManager(capacity=capacity)
+    ids = [crm.id_of(crm.add_node(NodeID.from_random(), NodeResources(
+        {"CPU": int(rng.integers(2, 32)),
+         "memory": int(rng.integers(1, 64))})))
+        for _ in range(n_nodes)]
+    class_reqs = [ResourceRequest({"CPU": int(rng.integers(1, 4)),
+                                   "memory": float(rng.integers(0, 6))})
+                  for _ in range(n_classes)]
+    vecs = np.stack([crm.intern_request(r) for r in class_reqs])
+    counts = rng.integers(1, 12, size=n_classes).astype(np.int32)
+    return rng, crm, ids, vecs, counts
+
+
+def _mutate(rng, crm, node_ids, debts):
+    """One beat's worth of random CRM churn: subtract / add_back /
+    drain / suspect / heartbeat-avail updates (>=1 mutation so delta
+    beats actually occur at every seed)."""
+    from ray_tpu.common.resources import ResourceRequest
+    one = ResourceRequest({"CPU": 1})
+    for _ in range(1 + int(rng.integers(0, 5))):
+        op = int(rng.integers(0, 5))
+        row = int(rng.integers(0, len(node_ids)))
+        if op == 0:
+            crm.force_subtract(row, one)
+            debts.append(row)
+        elif op == 1 and debts:
+            crm.add_back(debts.pop(int(rng.integers(0, len(debts)))), one)
+        elif op == 2:
+            crm.set_draining(node_ids[row], bool(rng.integers(0, 2)))
+        elif op == 3:
+            crm.set_suspect(row, bool(rng.integers(0, 2)))
+        else:
+            crm.update_node_available(
+                node_ids[row], {"CPU": int(rng.integers(0, 3200))})
+
+
+class TestDeltaSequenceOracle:
+    """Randomized delta-sequence parity (the r08 tentpole gate): a
+    DeltaScheduler fed random CRM mutations between beats stays
+    bit-identical, every beat, to (a) the CPU grouped oracle on a fresh
+    snapshot and (b) a cold engine that full-rescores from scratch —
+    and its carried key tensor matches ``contract.compute_keys``.
+    Seeded and replayable."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 7])
+    def test_random_mutation_sequence_bit_exact(self, seed):
+        from ray_tpu.scheduling import DeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(seed)
+        eng = DeltaScheduler(crm)
+        debts = []
+        thr = threshold_fp(None)
+        for _ in range(10):
+            _mutate(rng, crm, ids, debts)
+            got = eng.beat(vecs, counts)
+            want = schedule_grouped_oracle(crm.snapshot(), vecs, counts)
+            np.testing.assert_array_equal(got, want)
+            cold = DeltaScheduler(crm)
+            np.testing.assert_array_equal(cold.beat(vecs, counts), want)
+            st = crm.snapshot()
+            from ray_tpu.scheduling import compute_keys_batch
+            np.testing.assert_array_equal(
+                np.stack([eng.keys_row_host(v) for v in vecs]),
+                compute_keys_batch(st.totals, st.avail, vecs, thr,
+                                   st.node_mask))
+        assert eng.stats["delta_beats"] > 0
+        assert eng.hit_rate() > 0
+
+    def test_dirty_fraction_fallback_knob(self):
+        """scheduler_delta_max_dirty_fraction = 0 forces a full rescore
+        on every dirty beat — parity holds, hit rate records it."""
+        from ray_tpu.common.config import Config
+        from ray_tpu.scheduling import DeltaScheduler
+
+        try:
+            Config.reset({"scheduler_delta_max_dirty_fraction": 0.0})
+            rng, crm, ids, vecs, counts = _churn_cluster(3)
+            eng = DeltaScheduler(crm)
+            debts = []
+            for _ in range(5):
+                _mutate(rng, crm, ids, debts)
+                np.testing.assert_array_equal(
+                    eng.beat(vecs, counts),
+                    schedule_grouped_oracle(crm.snapshot(), vecs, counts))
+            assert eng.stats["delta_beats"] == 0
+            assert eng.stats["full_rescores"] == eng.stats["beats"]
+            assert eng.hit_rate() == 0.0
+        finally:
+            Config.reset()
+
+    def test_overrides_and_softmask_match_effective_snapshot(self):
+        """Per-beat avail overrides (planned-load debits) and the
+        suspect soft mask reproduce the snapshot path's arithmetic
+        bit-for-bit."""
+        from ray_tpu.scheduling import DeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(5)
+        eng = DeltaScheduler(crm)
+        eng.beat(vecs, counts)                  # warm sync
+        # planned-load debit on two rows + suspect row 1
+        over = {}
+        for row in (0, 1):
+            base = crm.arrays()[1][row].astype(np.int64)
+            base -= 150
+            over[row] = base.clip(-(2 ** 30), 2 ** 30).astype(np.int32)
+        sus = np.ones(crm.arrays()[0].shape[0], bool)
+        sus[1] = False
+        got = eng.beat(vecs, counts, overrides=over, extra_mask=sus)
+        st = crm.snapshot()
+        for row in (0, 1):
+            st.avail[row] = over[row]
+        st.node_mask = st.node_mask & sus       # frozen mask: rebind
+        np.testing.assert_array_equal(
+            got, schedule_grouped_oracle(st, vecs, counts))
+
+    def test_structural_growth_forces_resync(self):
+        """Node-capacity growth moves arrays under the mirror: the
+        journal truncates and the next beat full-rescores, bit-exact."""
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources
+        from ray_tpu.scheduling import DeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(11, capacity=24)
+        eng = DeltaScheduler(crm)
+        eng.beat(vecs, counts)
+        before = eng.stats["full_rescores"]
+        for _ in range(10):                     # outgrow capacity=24
+            crm.add_node(NodeID.from_random(),
+                         NodeResources({"CPU": 8}))
+        got = eng.beat(vecs, counts)
+        assert eng.stats["full_rescores"] == before + 1
+        np.testing.assert_array_equal(
+            got, schedule_grouped_oracle(crm.snapshot(), vecs, counts))
+
+    def test_class_retire_and_reuse(self):
+        """Retiring an interned class frees its slot; a new class takes
+        it over and scores correctly."""
+        from ray_tpu.common.resources import ResourceRequest
+        from ray_tpu.scheduling import DeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(13)
+        eng = DeltaScheduler(crm)
+        eng.beat(vecs, counts)
+        assert eng.retire_class(vecs[0])
+        assert not eng.retire_class(vecs[0])    # already gone
+        nv = crm.intern_request(ResourceRequest({"CPU": 2.5}))
+        got = eng.beat(np.stack([nv]), np.array([4], np.int32))
+        np.testing.assert_array_equal(
+            got, schedule_grouped_oracle(
+                crm.snapshot(), np.stack([nv]), np.array([4], np.int32)))
+
+
+class TestCrmEpochViews:
+    """Epoch counter, dirty journal, and memoized frozen views on the
+    ClusterResourceManager (r08 satellite)."""
+
+    def _crm(self, n=4):
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources
+        from ray_tpu.scheduling import ClusterResourceManager
+        crm = ClusterResourceManager(capacity=8)
+        rows = [crm.add_node(NodeID.from_random(),
+                             NodeResources({"CPU": 8}))
+                for _ in range(n)]
+        return crm, rows
+
+    def test_mutations_bump_epoch_and_journal_rows(self):
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        v0 = crm.version
+        crm.force_subtract(rows[2], ResourceRequest({"CPU": 1}))
+        v, _t, _a, _m, dirty = crm.delta_view(v0)
+        assert v > v0 and dirty == {rows[2]}
+        # a consumer synced at v sees a clean view
+        assert crm.delta_view(v)[4] == set()
+
+    def test_struct_growth_reports_full_resync(self):
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources
+        crm, rows = self._crm()
+        v0 = crm.version
+        for _ in range(8):                      # outgrow capacity=8
+            crm.add_node(NodeID.from_random(),
+                         NodeResources({"CPU": 4}))
+        assert crm.delta_view(v0)[4] is None    # None = resync required
+
+    def test_frozen_views_memoized_by_epoch(self):
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        t1 = crm.arrays()[0]
+        assert crm.arrays()[0] is t1            # same epoch: same object
+        assert not t1.flags.writeable
+        crm.force_subtract(rows[0], ResourceRequest({"CPU": 1}))
+        assert crm.arrays()[0] is not t1        # epoch moved: fresh copy
+        # snapshot avail stays per-call writable (policies mutate it)
+        snap = crm.snapshot()
+        assert snap.avail.flags.writeable
+        snap2 = crm.snapshot()
+        assert snap.avail is not snap2.avail
+
+    def test_request_vectors_interned_once(self):
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        a = crm.intern_request(ResourceRequest({"CPU": 2}))
+        b = crm.intern_request(ResourceRequest({"CPU": 2}))
+        assert a is b and not a.flags.writeable
+        c = crm.intern_request(ResourceRequest({"CPU": 3}))
+        assert c is not a

@@ -28,7 +28,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="repo root (default: rtlint's own checkout)")
     p.add_argument("--package", default="ray_tpu")
     p.add_argument("--rules", default=",".join(ALL_RULES),
-                   help="comma-separated subset of W1,W2,W3,W4,W5")
+                   help="comma-separated subset of W1,W2,W3,W4,W5,W6")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--baseline", default=None,
                    help="baseline path (default: tools/rtlint/baseline.json "

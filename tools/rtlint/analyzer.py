@@ -6,10 +6,11 @@ import ast
 import os
 
 from . import baseline as baseline_mod
-from . import rules_knobs, rules_locks, rules_threads, rules_time
+from . import (rules_device, rules_knobs, rules_locks, rules_threads,
+               rules_time)
 from .finding import Finding, sort_key
 
-ALL_RULES = ("W1", "W2", "W3", "W4", "W5")
+ALL_RULES = ("W1", "W2", "W3", "W4", "W5", "W6")
 
 
 class FileCtx:
@@ -83,6 +84,8 @@ def run_analysis(repo_root: str, package: str = "ray_tpu",
             findings.extend(rules_threads.scan_file(ctx))
         if "W5" in rules:
             findings.extend(rules_time.scan_file(ctx))
+        if "W6" in rules:
+            findings.extend(rules_device.scan_file(ctx))
 
     if "W1" in rules and lock_passes:
         findings.extend(rules_locks.interprocedural_w1(lock_passes))

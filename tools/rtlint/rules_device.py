@@ -1,0 +1,155 @@
+"""W6 heartbeat data-path discipline: no unsanctioned host<->device
+syncs in the scheduler kernels.
+
+The delta-heartbeat contract (scheduling/policy.py DeltaScheduler,
+ops/hybrid_kernel.py) allows exactly ONE device->host readback per
+beat — the fused counts fetch.  Every other sync point stalls the
+double-buffered pipeline: the host blocks, the staged upload for beat
+N+1 loses its overlap window, and the "delta" path quietly degrades
+to lock-step dispatch.  These bugs do not fail tests (results are
+identical); they only show up as a flat phase profile in bench.py.
+
+Scoped to ``ray_tpu/ops/``, ``ray_tpu/scheduling/``, and
+``ray_tpu/runtime/raylet.py`` (the code the heartbeat runs), the rule
+flags:
+
+- ``jax.device_get(...)`` — explicit device->host transfer;
+- ``<x>.block_until_ready(...)`` / ``jax.block_until_ready(...)`` —
+  a host stall on device work;
+- ``np.asarray(...)`` / ``np.array(...)`` inside a function that also
+  touches jax/jnp names — numpy coercion of a traced/device value is
+  an implicit blocking transfer (the most common accidental sync).
+
+Deliberate sites — the per-beat counts readback, the ``*_np`` host
+wrappers, the profile-mode phase timers — are either suppressed with
+``# rtlint: disable=W6`` or carried in the baseline; anything new is
+a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .finding import Finding
+
+_SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/")
+_EXTRA_FILES = ("ray_tpu/runtime/raylet.py",)
+_NP_COERCIONS = ("asarray", "array")
+
+
+def _suppressed(ctx, lineno) -> bool:
+    line = ctx.lines[lineno - 1] if 0 < lineno <= len(ctx.lines) else ""
+    m = re.search(r"rtlint:\s*disable=([\w,]+)", line)
+    return bool(m and ("W6" in m.group(1).split(",") or
+                       "all" in m.group(1).split(",")))
+
+
+def _qualname_index(tree):
+    quals = {}
+
+    def rec(body, prefix):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                rec(node.body, f"{prefix}{node.name}.")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                quals[node] = f"{prefix}{node.name}"
+                rec(node.body, f"{prefix}{node.name}.")
+
+    rec(tree.body, "")
+    return quals
+
+
+def _enclosing_fn(quals, target):
+    """Innermost function node containing ``target`` (None = module)."""
+    best = None
+    best_span = None
+    for fn in quals:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= target.lineno <= end:
+            span = end - fn.lineno
+            if best_span is None or span < best_span:
+                best, best_span = fn, span
+    return best
+
+
+def scan_file(ctx) -> list[Finding]:
+    path = ctx.path
+    if not (any(path.startswith(s) for s in _SCOPES)
+            or path in _EXTRA_FILES):
+        return []
+    tree = ctx.tree
+    quals = _qualname_index(tree)
+
+    # alias tables: jax / jax.numpy module names (incl. function-local
+    # `import jax` — the runtime modules import lazily), numpy names,
+    # and bare `from jax import device_get` style bindings
+    jax_names, np_names, bare_jax = set(), set(), {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    jax_names.add(a.asname or a.name.split(".")[0])
+                elif a.name == "numpy":
+                    np_names.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("jax", "jax.numpy"):
+                for a in node.names:
+                    if a.name == "numpy":
+                        jax_names.add(a.asname or "numpy")
+                    elif a.name in ("device_get", "block_until_ready"):
+                        bare_jax[a.asname or a.name] = a.name
+
+    # functions that touch jax: np coercions inside them are treated
+    # as potential implicit syncs
+    touches_jax: dict[ast.AST, bool] = {}
+    for fn in quals:
+        touches_jax[fn] = any(
+            isinstance(n, ast.Name) and n.id in jax_names
+            for n in ast.walk(fn))
+
+    per_sym: dict[tuple, int] = {}
+    findings: list[Finding] = []
+
+    def emit(call, kind, shape, hint):
+        if _suppressed(ctx, call.lineno):
+            return
+        fn = _enclosing_fn(quals, call)
+        sym = quals.get(fn, "<module>")
+        n = per_sym.get((sym, kind), 0)
+        per_sym[(sym, kind)] = n + 1
+        findings.append(Finding(
+            rule="W6", path=path, line=call.lineno, symbol=sym,
+            message=(f"{shape} is a host<->device sync in the heartbeat "
+                     f"path — it stalls the double-buffered beat "
+                     f"pipeline"),
+            hint=hint,
+            detail=f"sync:{kind}@{sym}" + (f"#{n}" if n else "")))
+
+    batch_hint = ("batch into the one sanctioned per-beat counts "
+                  "readback, or mark a deliberate site with "
+                  "# rtlint: disable=W6")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if f.attr == "device_get" and isinstance(recv, ast.Name) \
+                    and recv.id in jax_names:
+                emit(node, "device_get", f"{recv.id}.device_get(...)",
+                     batch_hint)
+            elif f.attr == "block_until_ready":
+                emit(node, "block_until_ready",
+                     f"<...>.block_until_ready(...)", batch_hint)
+            elif f.attr in _NP_COERCIONS and isinstance(recv, ast.Name) \
+                    and recv.id in np_names:
+                fn = _enclosing_fn(quals, node)
+                if fn is not None and touches_jax.get(fn):
+                    emit(node, f.attr, f"{recv.id}.{f.attr}(...) in a "
+                         f"jax-touching function",
+                         "if the operand is a device value this blocks; "
+                         + batch_hint)
+        elif isinstance(f, ast.Name) and f.id in bare_jax:
+            emit(node, bare_jax[f.id], f"{f.id}(...)", batch_hint)
+    return findings
