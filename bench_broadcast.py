@@ -1,0 +1,158 @@
+"""Broadcast-plane benchmark: relay tree vs naive repeated-pull.
+
+Two backends, one JSON record:
+
+* ``sim`` — a 1 GB object to {4, 16, 64} replicas on the event-scheduled
+  model (``sim/broadcast.py``, virtual seconds, deterministic).  The
+  naive baseline is the same wave at fanout=N: every replica pulls the
+  whole object straight off the root's serialized uplink — exactly
+  repeated-pull.
+* ``socket`` — real endpoint planes over real sockets with the uplink
+  paced by ``plane_uplink_mbps``, a 16 MB object to {4, 16} replicas
+  (wall seconds).  The 16-replica tree/naive ratio is the acceptance
+  number: the relay tree must be >= 3x faster than 16 concurrent pulls
+  hammering one source.  The socket uplink is paced LOW (50 MB/s) so
+  the network model dominates the measurement rather than this host's
+  memcpy throughput, and the arenas live on tmpfs so page-cache
+  writeback from earlier measurements cannot bleed into later ones.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+SIM_REPLICAS = (4, 16, 64)
+SIM_SIZE_MB = 1024
+SIM_UPLINK_MBPS = 1000
+SOCKET_REPLICAS = (4, 16)
+SOCKET_SIZE_MB = 16
+SOCKET_UPLINK_MBPS = 50
+# tmpfs keeps arena pages out of disk writeback; fall back to the
+# default tmp when the host has no /dev/shm
+_SHM = "/dev/shm" if os.path.isdir("/dev/shm") else None
+
+
+def _sim_time(num_nodes: int, fanout: int) -> float:
+    from ray_tpu.sim.broadcast import SimBroadcastWave
+    from ray_tpu.sim.cluster import SimCluster
+    with SimCluster(num_nodes, seed=1) as c:
+        members = [f"n{i:05d}" for i in range(num_nodes)]
+        w = SimBroadcastWave(c, "bench", members, size_mb=SIM_SIZE_MB,
+                             chunk_mb=8, fanout=fanout,
+                             uplink_mbps=SIM_UPLINK_MBPS)
+        w.start()
+        c.clock.run_until(600.0)
+        assert len(w.completed) == num_nodes, \
+            (num_nodes, fanout, len(w.completed))
+        return w.time_to_all
+
+
+def _socket_times(tmp: str, n_members: int) -> tuple[float, float]:
+    """(tree_s, naive_s) for one paced 1->N distribution."""
+    from ray_tpu.common.config import Config
+    from ray_tpu.common.ids import ObjectID
+    from ray_tpu.native import Arena
+    from ray_tpu.rpc import RpcServer
+    from ray_tpu.runtime.object_plane import ObjectPlane
+    from ray_tpu.runtime.object_store import MemoryStore
+    from ray_tpu.runtime.serialization import serialize
+
+    Config.reset({"broadcast_chunk_mb": 2, "broadcast_window": 4,
+                  "object_transfer_chunk_mb": 2,
+                  "plane_uplink_mbps": SOCKET_UPLINK_MBPS})
+    payload = b"\xb7" * (SOCKET_SIZE_MB << 20)
+
+    def endpoint(name):
+        arena = Arena(os.path.join(tmp, f"a_{name}"),
+                      (SOCKET_SIZE_MB + 8) << 20, create=True)
+        store = MemoryStore(arena=arena,
+                            spill_dir=os.path.join(tmp, f"s_{name}"))
+        plane = ObjectPlane(store)
+        server = RpcServer({}).start()
+        plane.attach(server)
+        return plane, store, server
+
+    made = []
+    try:
+        out = []
+        for mode in ("tree", "naive"):
+            root_plane, root_store, root_server = endpoint(
+                f"{n_members}_{mode}_r")
+            made.append((root_plane, root_server))
+            oid = ObjectID.from_random()
+            root_store.put_serialized(oid, serialize(payload))
+            _kind, size = root_store.plasma_info(oid)
+            members = []
+            for i in range(n_members):
+                p, _s, srv = endpoint(f"{n_members}_{mode}_{i}")
+                made.append((p, srv))
+                members.append(p)
+            t0 = time.perf_counter()
+            if mode == "tree":
+                res = root_plane.broadcast(
+                    oid, [m.serve_address for m in members], fanout=2)
+                assert res["ok"], res
+            else:
+                # naive repeated-pull: every member pulls the whole
+                # object from the root, all at once
+                oks = []
+                ts = [threading.Thread(
+                    target=lambda m=m: oks.append(m.pull_into_local(
+                        oid, size, root_plane.serve_address)))
+                    for m in members]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                assert all(oks), oks
+            out.append(time.perf_counter() - t0)
+        return out[0], out[1]
+    finally:
+        for plane, server in made:
+            plane.shutdown()
+            server.stop()
+
+
+def main():
+    rows = []
+    for n in SIM_REPLICAS:
+        tree_s = _sim_time(n, fanout=2)
+        naive_s = _sim_time(n, fanout=n)
+        rows.append({"backend": "sim", "replicas": n,
+                     "size_mb": SIM_SIZE_MB,
+                     "tree_s": round(tree_s, 3),
+                     "naive_s": round(naive_s, 3),
+                     "speedup": round(naive_s / tree_s, 2)})
+
+    ratio_16 = None
+    with tempfile.TemporaryDirectory(dir=_SHM) as tmp:
+        for n in SOCKET_REPLICAS:
+            tree_s, naive_s = _socket_times(tmp, n)
+            ratio = naive_s / tree_s
+            if n == 16:
+                ratio_16 = ratio
+            rows.append({"backend": "socket", "replicas": n,
+                         "size_mb": SOCKET_SIZE_MB,
+                         "tree_s": round(tree_s, 3),
+                         "naive_s": round(naive_s, 3),
+                         "speedup": round(ratio, 2)})
+
+    print(json.dumps({
+        "metric": f"relay-tree broadcast vs naive repeated-pull "
+                  f"({SIM_SIZE_MB} MB sim x {SIM_REPLICAS} @ "
+                  f"{SIM_UPLINK_MBPS} MB/s, "
+                  f"{SOCKET_SIZE_MB} MB socket x {SOCKET_REPLICAS} @ "
+                  f"{SOCKET_UPLINK_MBPS} MB/s)",
+        "value": round(ratio_16, 2),
+        "unit": "x faster at 16 replicas (socket)",
+        "vs_baseline": round(ratio_16, 2),
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

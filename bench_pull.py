@@ -34,13 +34,15 @@ def main():
 
     d_loc, d_bw = jnp.asarray(loc), jnp.asarray(bw)
     d_dest, d_sizes = jnp.asarray(dest), jnp.asarray(sizes)
+    d_infl = jnp.zeros(N_NODES, dtype=jnp.int32)
     src_dev, cost_dev = (np.asarray(x) for x in
-                         choose_sources(d_loc, d_bw, d_dest, d_sizes))
+                         choose_sources(d_loc, d_bw, d_dest, d_sizes,
+                                        d_infl))
 
     times = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        s, c = choose_sources(d_loc, d_bw, d_dest, d_sizes)
+        s, c = choose_sources(d_loc, d_bw, d_dest, d_sizes, d_infl)
         np.asarray(s)
         times.append((time.perf_counter() - t0) * 1e3)
     p50 = float(np.percentile(times, 50))

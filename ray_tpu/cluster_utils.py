@@ -127,6 +127,10 @@ class Cluster:
         self.plane = ObjectPlane(self.store)
         self.planes: dict[int, str | None] = {}
         self.pull_manager = PullManager(self)
+        # 1->N sibling of the pull manager: relay-tree weight
+        # distribution over the same bandwidth matrix
+        from .broadcast import BroadcastManager
+        self.broadcasts = BroadcastManager(self)
         self.recovery = ObjectRecoveryManager(self)
         # owner-side reference counting: ObjectRefs created in this
         # (driver) process drive reclamation of out-of-scope objects
@@ -711,6 +715,7 @@ class Cluster:
         self.ref_counter.shutdown()
         self.pg_manager.shutdown()
         self.pull_manager.shutdown()
+        self.broadcasts.shutdown()
         self.plane.shutdown()
         with self._lock:
             raylets = list(self.raylets.values())

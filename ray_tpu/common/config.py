@@ -148,6 +148,46 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Minimum pending placement-group batch routed to the device "
         "gang-placement kernel (ops/bundle_kernel.py); smaller batches "
         "use the bit-identical CPU path."),
+    # -- broadcast plane (1->N weight distribution) --------------------------
+    "broadcast_fanout": (
+        int, 2,
+        "Maximum children per node in the broadcast tree.  2 keeps "
+        "every uplink at half rate (time-to-all ~ 2*S/U + depth "
+        "pipeline fill); raise it on fat-uplink topologies where one "
+        "source can feed more receivers at full rate."),
+    "broadcast_chunk_mb": (
+        int, 8,
+        "Relay granularity: a receiver becomes a source for a chunk "
+        "the moment that chunk lands (relay-as-you-receive).  Smaller "
+        "chunks shorten the per-hop pipeline-fill delay, larger ones "
+        "amortize request overhead on the raw channel."),
+    "broadcast_window": (
+        int, 4,
+        "Chunk requests a relay keeps in flight against its parent "
+        "(windowed pipelining on one connection, like "
+        "object_transfer_window but per broadcast edge)."),
+    "broadcast_fetch_timeout_s": (
+        float, 60.0,
+        "Per-chunk deadline on a broadcast edge: a relay whose parent "
+        "produces no chunk completion for this long declares the "
+        "parent dead and re-parents to the next fallback ancestor."),
+    "broadcast_device_batch_min": (
+        int, 128,
+        "Minimum member count routed to the device fan-out-plan kernel "
+        "(ops/broadcast_kernel.py); smaller trees use the bit-identical "
+        "numpy oracle."),
+    "broadcast_join_pulls": (
+        bool, True,
+        "Let the pull manager graft concurrent pulls of an in-flight "
+        "broadcast object onto the broadcast tree as new leaves "
+        "instead of opening fresh source streams against the origin."),
+    "plane_uplink_mbps": (
+        float, 0.0,
+        "Per-endpoint outbound pacing for object-plane chunk serving "
+        "(MB/s across op_fetch/op_read/bc_fetch replies; 0 = uncapped). "
+        "Models a bounded node uplink on loopback test rigs so tree "
+        "vs naive fan-out shapes are measurable; also usable as a "
+        "crude egress throttle on shared NICs."),
     "runtime_env_wheelhouse": (
         str, "",
         "Local wheel directory for runtime_env pip provisioning: "

@@ -1,0 +1,154 @@
+"""Device broadcast tree shaping: the fan-out plan kernel.
+
+1->N weight distribution wants a relay tree whose shape follows the
+node-bandwidth matrix — the same dense HBM-resident input the pull
+cost model (``ops/pull_kernel.py``) scores transfer sources against.
+This kernel reuses that formulation for the 1->N case: given the
+member set, the root, a fan-out cap and the current per-node uplink
+load, it emits a parent assignment plus the attach order (the chunk
+schedule follows attach order — an earlier-attached member starts
+receiving, and therefore relaying, sooner).
+
+Greedy one-attach-per-step construction, all int32:
+
+    step k:  eff[p, c] = covered[p] & member[c] & ~covered[c]
+                           & children[p] < fanout & bw[p, c] > 0
+               ? max(bw[p, c] // ((1 + children[p]
+                                     + inflight_kb[p] // UNIT)
+                                  * (1 + depth[p])), 1) : 0
+             (p*, c*) = argmax eff   (flat row-major, first max)
+             parent[c*] = p*; order[c*] = k
+             depth[c*] = depth[p*] + 1; children[p*] += 1
+
+Two deratings shape the tree.  The load term (children + uplink
+in-flight, same 32 MB stream unit as the pull cost model) makes a
+parent that already feeds children progressively less attractive, so
+the tree spreads across the topology instead of every member chaining
+off the root.  The depth term charges a parent for its own distance
+from the root — without it a freshly attached leaf always out-scores
+a once-loaded parent and a uniform-bandwidth matrix degenerates to an
+N-deep chain; with it the same matrix yields a balanced fanout-F tree
+(depth ~log_F N).  Ties break to the lowest (parent, child) pair —
+deterministic on both backends.  The CPU oracle below is bit-identical
+(same discipline as the hybrid/pull kernels); ``plan_fanout_np`` pads
+the node axis to a power-of-2 bucket for a stable XLA compile cache.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# same stream-equivalent unit as the pull cost model: every 32 MB in
+# flight on a node's uplink counts as one extra concurrent stream
+_INFLIGHT_UNIT_KB = np.int32(32 * 1024)
+
+
+@jax.jit
+def plan_fanout(member, bw, root, fanout, inflight_kb):
+    """Shape the broadcast tree, on device.
+
+    member: (N,) bool — broadcast participants (root included).
+    bw: (N, N) int32 — bandwidth in MB/s, ``bw[src, dst]``.
+    root: int32 scalar — row of the origin replica.
+    fanout: int32 scalar — max children per node (>= 1).
+    inflight_kb: (N,) int32 — KB already in flight FROM each node.
+
+    Returns (parent (N,) int32, order (N,) int32): ``parent[c]`` is the
+    node c relays from (-1 for the root and non-members), ``order[c]``
+    the attach step (0-based; -1 for the root and non-members).  A
+    member left unattached (unreachable bandwidth row) keeps -1/-1.
+    """
+    n = member.shape[0]
+    units = inflight_kb.astype(jnp.int32) // _INFLIGHT_UNIT_KB
+
+    def body(k, state):
+        covered, children, depth, parent, order = state
+        # (p, c) eligibility + load- and depth-derated uplink score
+        can_parent = covered & (children < fanout)          # (N,)
+        want_child = member & ~covered                      # (N,)
+        denom = (1 + children + units) * (1 + depth)        # (N,)
+        eff = jnp.where(
+            can_parent[:, None] & want_child[None, :] & (bw > 0),
+            jnp.maximum(bw // denom[:, None], 1), 0)
+        idx = jnp.argmax(eff.reshape(-1)).astype(jnp.int32)
+        p, c = idx // n, idx % n
+        hit = eff.reshape(-1)[idx] > 0
+        parent = parent.at[c].set(jnp.where(hit, p, parent[c]))
+        order = order.at[c].set(jnp.where(hit, k, order[c]))
+        depth = depth.at[c].set(jnp.where(hit, depth[p] + 1, depth[c]))
+        covered = covered.at[c].set(jnp.where(hit, True, covered[c]))
+        children = children.at[p].add(jnp.where(hit, 1, 0))
+        return covered, children, depth, parent, order
+
+    covered0 = jnp.zeros((n,), dtype=bool).at[root].set(True)
+    state = (covered0,
+             jnp.zeros((n,), dtype=jnp.int32),
+             jnp.zeros((n,), dtype=jnp.int32),
+             jnp.full((n,), -1, dtype=jnp.int32),
+             jnp.full((n,), -1, dtype=jnp.int32))
+    _cov, _ch, _dep, parent, order = jax.lax.fori_loop(0, n, body, state)
+    return parent, order
+
+
+def plan_fanout_oracle(member: np.ndarray, bw: np.ndarray, root: int,
+                       fanout: int,
+                       inflight_kb: np.ndarray | None = None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy oracle — bit-identical to ``plan_fanout``."""
+    member = np.asarray(member, dtype=bool)
+    bw = np.asarray(bw, dtype=np.int32)
+    n = member.shape[0]
+    units = np.zeros(n, dtype=np.int32)
+    if inflight_kb is not None:
+        units[:] = np.asarray(inflight_kb,
+                              dtype=np.int32) // _INFLIGHT_UNIT_KB
+    covered = np.zeros(n, dtype=bool)
+    covered[root] = True
+    children = np.zeros(n, dtype=np.int32)
+    depth = np.zeros(n, dtype=np.int32)
+    parent = np.full(n, -1, dtype=np.int32)
+    order = np.full(n, -1, dtype=np.int32)
+    for k in range(n):
+        can_parent = covered & (children < fanout)
+        want_child = member & ~covered
+        denom = ((1 + children + units) * (1 + depth)).astype(np.int32)
+        eff = np.where(
+            can_parent[:, None] & want_child[None, :] & (bw > 0),
+            np.maximum(bw // denom[:, None], np.int32(1)),
+            np.int32(0)).astype(np.int32)
+        idx = int(eff.reshape(-1).argmax())
+        p, c = idx // n, idx % n
+        if eff.reshape(-1)[idx] <= 0:
+            continue        # matches the device no-op step
+        parent[c] = p
+        order[c] = k
+        depth[c] = depth[p] + 1
+        covered[c] = True
+        children[p] += 1
+    return parent, order
+
+
+def plan_fanout_np(member, bw, root: int, fanout: int, inflight_kb=None):
+    """Host wrapper for the device kernel: pads the node axis to a
+    power-of-2 bucket (stable XLA compile cache) and returns numpy
+    arrays.  Padded rows are non-members with zero bandwidth, so they
+    can never be chosen; step count grows with the padding but every
+    extra step is a no-op argmax over zeros."""
+    member = np.asarray(member, dtype=bool)     # rtlint: disable=W6
+    n = member.shape[0]
+    npad = max(8, 1 << (n - 1).bit_length())
+    mem_p = np.zeros(npad, dtype=bool)
+    mem_p[:n] = member
+    bw_p = np.zeros((npad, npad), dtype=np.int32)
+    bw_p[:n, :n] = bw
+    infl_p = np.zeros(npad, dtype=np.int32)
+    if inflight_kb is not None:
+        infl_p[:n] = inflight_kb
+    parent, order = plan_fanout(
+        jnp.asarray(mem_p), jnp.asarray(bw_p),
+        jnp.int32(root), jnp.int32(fanout), jnp.asarray(infl_p))
+    parent = np.asarray(parent)[:n]             # rtlint: disable=W6
+    order = np.asarray(order)[:n]               # rtlint: disable=W6
+    return parent, order

@@ -8,15 +8,24 @@ north star singles out: "the Plasma object store's pull-manager cost model
 layer 6, §3.3; mount empty).
 
 TPU-first formulation: one batch of R pending pull requests is a dense
-computation over the (N x N) node-bandwidth matrix resident in HBM —
+computation over the (N x N) node-bandwidth matrix resident in HBM.  For
+request r the candidate score is the source's bandwidth to the
+destination derated by the bytes already in flight FROM that source —
 
-    eff[r, n]  = loc[r, n] ? bw[n, dest[r]] : 0
-    src[r]     = argmax_n eff[r, n]        (first max -> deterministic)
-    cost[r]    = size_kb[r] // bw[src[r], dest[r]]   (~ transfer ms)
+    eff[r, n] = loc[r, n] & bw[n, dest[r]] > 0
+                  ? max(bw[n, dest[r]] // (1 + infl[n] // UNIT), 1) : 0
+    src[r]    = argmax_n eff[r, n]          (first max -> deterministic)
+    cost[r]   = size_kb[r] // eff[src[r]]   (~ transfer ms)
+    infl[src[r]] += size_kb[r]              (sequential greedy)
 
-instead of a per-request host loop over object locations.  All arithmetic
-is int32 (sizes in KB, bandwidth in MB/s, cost in ~ms), so CPU and TPU
-agree bit-for-bit with the numpy oracle below.
+The in-flight update runs SEQUENTIALLY over the batch (a fori_loop on
+device, a plain loop in the oracle): two concurrent pulls in one
+activation round therefore spread across replicas instead of both
+piling onto the same "cheapest" source — the bug the derating exists to
+fix.  With a zero in-flight vector the selection is bit-identical to
+the historical pure-argmax kernel.  All arithmetic is int32 (sizes in
+KB, bandwidth in MB/s, cost in ~ms), so CPU and TPU agree bit-for-bit
+with the numpy oracle below.
 """
 
 from __future__ import annotations
@@ -26,47 +35,89 @@ import jax.numpy as jnp
 import numpy as np
 
 _NO_SOURCE_COST = np.int32(2**31 - 1)
+# in-flight derating unit: one "stream equivalent" per 32 MB already
+# queued on a source's uplink (4 default-size chunks) — eff bandwidth is
+# the fair share bw / (1 + streams)
+_INFLIGHT_UNIT_KB = np.int32(32 * 1024)
 
 
 @jax.jit
-def choose_sources(loc, bw, dest, sizes_kb):
+def choose_sources(loc, bw, dest, sizes_kb, inflight_kb):
     """Pick the best transfer source for each pull request, on device.
 
     loc: (R, N) bool — which nodes hold a copy of each object.
     bw: (N, N) int32 — bandwidth in MB/s, ``bw[src, dst]``.
     dest: (R,) int32 — requesting node row per request.
     sizes_kb: (R,) int32 — object size in KB.
+    inflight_kb: (N,) int32 — KB already assigned to transfers FROM
+        each node (this batch's own picks accumulate on top).
 
     Returns (src (R,) int32, cost (R,) int32): ``src = -1`` when no node
-    holds the object; cost ~ transfer milliseconds (KB // MB/s), used for
-    activation ordering.  Deterministic: ties break to the lowest row.
+    holds the object; cost ~ transfer milliseconds (KB // eff-MB/s), used
+    for activation ordering.  Deterministic: ties break to the lowest row.
     """
+    r = loc.shape[0]
     bw_to_dest = bw[:, dest].T                      # (R, N)
-    eff = jnp.where(loc, bw_to_dest, 0)
-    src = jnp.argmax(eff, axis=1).astype(jnp.int32)
-    best = jnp.take_along_axis(eff, src[:, None], axis=1)[:, 0]
-    cost = jnp.where(best > 0, sizes_kb // jnp.maximum(best, 1),
-                     _NO_SOURCE_COST)
-    return jnp.where(best > 0, src, -1), cost
+
+    def body(i, state):
+        infl, src_acc, cost_acc = state
+        raw = bw_to_dest[i]
+        eff = jnp.where(
+            loc[i] & (raw > 0),
+            jnp.maximum(raw // (1 + infl // _INFLIGHT_UNIT_KB), 1), 0)
+        s = jnp.argmax(eff).astype(jnp.int32)
+        best = eff[s]
+        picked = best > 0
+        src_i = jnp.where(picked, s, -1)
+        cost_i = jnp.where(picked, sizes_kb[i] // jnp.maximum(best, 1),
+                           _NO_SOURCE_COST)
+        infl = infl.at[jnp.where(picked, s, 0)].add(
+            jnp.where(picked, sizes_kb[i], 0))
+        return (infl, src_acc.at[i].set(src_i),
+                cost_acc.at[i].set(cost_i))
+
+    _infl, src, cost = jax.lax.fori_loop(
+        0, r, body,
+        (inflight_kb.astype(jnp.int32),
+         jnp.full((r,), -1, dtype=jnp.int32),
+         jnp.full((r,), _NO_SOURCE_COST, dtype=jnp.int32)))
+    return src, cost
 
 
 def choose_sources_oracle(loc: np.ndarray, bw: np.ndarray, dest: np.ndarray,
-                          sizes_kb: np.ndarray
+                          sizes_kb: np.ndarray,
+                          inflight_kb: np.ndarray | None = None
                           ) -> tuple[np.ndarray, np.ndarray]:
     """Numpy oracle — bit-identical to ``choose_sources``."""
     loc = np.asarray(loc, dtype=bool)
     bw = np.asarray(bw, dtype=np.int32)
     dest = np.asarray(dest, dtype=np.int32)
     sizes_kb = np.asarray(sizes_kb, dtype=np.int32)
-    eff = np.where(loc, bw[:, dest].T, 0).astype(np.int32)
-    src = eff.argmax(axis=1).astype(np.int32)
-    best = np.take_along_axis(eff, src[:, None], axis=1)[:, 0]
-    cost = np.where(best > 0, sizes_kb // np.maximum(best, 1),
-                    _NO_SOURCE_COST).astype(np.int32)
-    return np.where(best > 0, src, -1).astype(np.int32), cost
+    n = bw.shape[0]
+    infl = np.zeros(n, dtype=np.int32)
+    if inflight_kb is not None:
+        infl[:] = np.asarray(inflight_kb, dtype=np.int32)
+    r = loc.shape[0]
+    src = np.full(r, -1, dtype=np.int32)
+    cost = np.full(r, _NO_SOURCE_COST, dtype=np.int32)
+    bw_to_dest = bw[:, dest].T
+    for i in range(r):
+        raw = bw_to_dest[i]
+        eff = np.where(
+            loc[i] & (raw > 0),
+            np.maximum(raw // (1 + infl // _INFLIGHT_UNIT_KB),
+                       np.int32(1)),
+            np.int32(0)).astype(np.int32)
+        s = np.int32(eff.argmax())
+        best = eff[s]
+        if best > 0:
+            src[i] = s
+            cost[i] = sizes_kb[i] // max(np.int32(1), best)
+            infl[s] += sizes_kb[i]
+    return src, cost
 
 
-def choose_sources_np(loc, bw, dest, sizes_kb):
+def choose_sources_np(loc, bw, dest, sizes_kb, inflight_kb=None):
     """Host wrapper for the device kernel: pads the request axis to a
     power-of-2 bucket (avoids a fresh XLA compile per batch size) and
     returns numpy arrays."""
@@ -80,7 +131,10 @@ def choose_sources_np(loc, bw, dest, sizes_kb):
     dest_p[:r] = dest
     sizes_p = np.zeros(rp, dtype=np.int32)
     sizes_p[:r] = sizes_kb
+    infl = np.zeros(n, dtype=np.int32)
+    if inflight_kb is not None:
+        infl[:] = inflight_kb
     src, cost = choose_sources(
         jnp.asarray(loc_p), jnp.asarray(bw, dtype=jnp.int32),
-        jnp.asarray(dest_p), jnp.asarray(sizes_p))
+        jnp.asarray(dest_p), jnp.asarray(sizes_p), jnp.asarray(infl))
     return np.asarray(src)[:r], np.asarray(cost)[:r]

@@ -243,6 +243,13 @@ class RpcServer:
 
     def stop(self) -> None:
         self._stopped = True
+        # shutdown BEFORE close here too: close() alone does not wake a
+        # thread blocked in accept(), which then lingers and can steal
+        # connections if the listener fd number is later reused
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

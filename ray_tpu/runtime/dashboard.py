@@ -95,6 +95,16 @@ class Dashboard(BackgroundHTTPServer):
                 return request_plane_stats()
             except Exception:   # noqa: BLE001 — serve absent/unused
                 return {}
+        if name == "broadcasts":
+            cluster = self._cluster
+            out = {}
+            broadcasts = getattr(cluster, "broadcasts", None)
+            if broadcasts is not None:
+                out.update(broadcasts.stats())
+            plane = getattr(cluster, "plane", None)
+            if plane is not None:
+                out.update(plane.bcast.stats())
+            return out
         if name == "health":
             from ..rpc import breaker, chaos
             cluster = self._cluster
@@ -197,6 +207,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
             '<a href="/api/serve">serve</a> · '
+            '<a href="/api/broadcasts">broadcasts</a> · '
             '<a href="/api/health">health</a> · '
             '<a href="/api/stacks">stacks</a> · '
             '<a href="/api/timeline">timeline</a> · '

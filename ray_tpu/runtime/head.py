@@ -371,6 +371,7 @@ class HeadNode:
             "store": cluster.store.stats(),
             "object_plane": cluster.plane.stats(),
             "pulls": cluster.pull_manager.stats(),
+            "broadcasts": cluster.broadcasts.stats(),
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
             "serve": self._serve_stats(),

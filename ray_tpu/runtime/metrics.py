@@ -93,6 +93,38 @@ def render_metrics(cluster) -> str:
              "Transfer sources currently blacklisted for repeated "
              "failures", out=out)
 
+    # broadcast plane (1->N relay trees)
+    broadcasts = getattr(cluster, "broadcasts", None)
+    if broadcasts is not None:
+        bs = broadcasts.stats()
+        _fmt("broadcast_active_trees", bs["bcast_active_trees"],
+             "Broadcast trees currently distributing", out=out)
+        _fmt("broadcast_trees_completed_total",
+             bs["bcast_trees_completed"],
+             "Broadcast trees fully distributed (cumulative)", out=out)
+        _fmt("broadcast_members_reached_total",
+             bs["bcast_members_reached"],
+             "Replicas sealed through broadcast trees (cumulative)",
+             out=out)
+        _fmt("broadcast_joins_total", bs["bcast_joins"],
+             "Concurrent pulls grafted onto an active tree "
+             "(cumulative)", out=out)
+        _fmt("broadcast_relay_fanout", bs["bcast_relay_fanout"],
+             "Mean children per relaying node, last tree", out=out)
+        _fmt("broadcast_time_to_all_ewma_seconds",
+             bs["bcast_time_to_all_ewma_s"],
+             "Smoothed time-to-all-replicas across broadcasts", out=out)
+        if obj_plane is not None:
+            es = obj_plane.bcast.stats()
+            _fmt("broadcast_chunks_relayed_total",
+                 es["bcast_chunks_relayed"],
+                 "Chunks served from live relay sessions (cumulative)",
+                 out=out)
+            _fmt("broadcast_chunks_pulled_total",
+                 es["bcast_chunks_pulled"],
+                 "Chunks fetched from parents by relay sessions "
+                 "(cumulative)", out=out)
+
     # ownership / lineage
     ts = cluster.task_manager.stats()
     _fmt("lineage_retained_specs", ts["num_done_retained"],

@@ -75,10 +75,18 @@ class ObjectPlane:
     TO this plane work without it."""
 
     def __init__(self, store):
+        from ..broadcast.relay import BroadcastEndpoint
         self.store = store
         self.serve_address: str | None = None
         self._peers: dict[str, object] = {}     # address -> RpcClient
         self._peers_lock = threading.Lock()
+        # broadcast plane: relay sessions + bc_* wire surface ride on
+        # this plane's server and peer cache
+        self.bcast = BroadcastEndpoint(self)
+        # outbound pacing (plane_uplink_mbps): serialized token bucket
+        # over every chunk-serving reply on this endpoint
+        self._uplink_lock = threading.Lock()
+        self._uplink_free = 0.0     # monotonic instant the link frees
         self._gc_q: deque = deque()             # (address, [oid_bin])
         self._gc_cv = threading.Condition()
         self._gc_thread: threading.Thread | None = None
@@ -109,7 +117,7 @@ class ObjectPlane:
 
     # -- serving side (attach to an RpcServer) ------------------------------
     def handlers(self) -> dict:
-        return {
+        out = {
             "op_stat": self._op_stat,
             "op_read": self._op_read,
             "op_fetch": self._op_fetch,
@@ -117,6 +125,8 @@ class ObjectPlane:
             "op_free": self._op_free,
             "op_plane_stats": self._op_plane_stats,
         }
+        out.update(self.bcast.handlers())
+        return out
 
     def attach(self, server) -> None:
         for name, fn in self.handlers().items():
@@ -126,6 +136,24 @@ class ObjectPlane:
     def _op_stat(self, oid_bin: bytes):
         return self.store.plasma_info(ObjectID(oid_bin))
 
+    def throttle_uplink(self, nbytes: int) -> None:
+        """Outbound pacing: when ``plane_uplink_mbps`` caps this
+        endpoint's serving rate, delay the reply until the modeled link
+        frees.  Token-bucket over a shared next-free instant; the sleep
+        runs OUTSIDE the lock, so concurrent chunk serves queue behind
+        each other exactly like frames on one uplink."""
+        rate = get_config().plane_uplink_mbps
+        if rate <= 0 or nbytes <= 0:
+            return
+        cost = nbytes / (rate * (1 << 20))
+        with self._uplink_lock:
+            now = _clk.monotonic()
+            start = max(now, self._uplink_free)
+            self._uplink_free = start + cost
+            wait = start + cost - now
+        if wait > 0:
+            _clk.sleep(wait)
+
     def _op_read(self, oid_bin: bytes, offset: int,
                  length: int) -> bytes | None:
         """Pickled-channel chunk (compat / raw-channel-off fallback)."""
@@ -133,6 +161,7 @@ class ObjectPlane:
         if data is not None:
             self.bytes_sent += len(data)
             self.bytes_sent_pickled += len(data)
+            self.throttle_uplink(len(data))
         return data
 
     def _op_fetch(self, oid_bin: bytes, offset: int, length: int):
@@ -153,6 +182,7 @@ class ObjectPlane:
         n = buf.nbytes if isinstance(buf, memoryview) else len(buf)
         self.bytes_sent += n
         self.bytes_sent_raw += n
+        self.throttle_uplink(n)
         return RawResult((kind, size), buf, release=release)
 
     def _op_pull(self, oid_bin: bytes, size: int, src_addr: str,
@@ -182,6 +212,7 @@ class ObjectPlane:
             "plane_last_transfer_mbps": round(self.last_transfer_mbps, 2),
             "plane_ewma_transfer_mbps": round(self.ewma_transfer_mbps, 2),
             "plane_blacklisted_sources": len(self.blacklisted_sources()),
+            **self.bcast.stats(),
         }
 
     def _op_plane_stats(self) -> dict:
@@ -480,6 +511,55 @@ class ObjectPlane:
             raise PlaneTransferError(
                 f"transfer of {oid.hex()[:12]} incomplete: "
                 f"{written}/{src_size} bytes")
+
+    # -- broadcast (1->N) ----------------------------------------------------
+    def broadcast(self, oid: ObjectID, member_addrs, size: int = 0,
+                  fanout: int | None = None,
+                  timeout: float | None = None) -> dict:
+        """Distribute a locally sealed object to every plane in
+        ``member_addrs`` through a relay tree rooted HERE (this plane
+        must hold the bytes).  Plane-level primitive: with no bandwidth
+        matrix in sight the tree is index-ordered balanced F-ary
+        (``broadcast/plan.py``); the cluster-level coordinator
+        (``BroadcastManager``) shapes topology-aware trees instead.
+        Returns {"ok", "reached": [addr...], "failed": [addr...]}."""
+        from ..broadcast.plan import balanced_plan
+        kind, local_size = self.store.plasma_info(oid)
+        if kind not in _SERVABLE:
+            return {"ok": False, "reached": [], "failed":
+                    list(member_addrs), "error": "no local bytes"}
+        size = int(size) or int(local_size)
+        cfg = get_config()
+        chunk = cfg.broadcast_chunk_mb * (1 << 20)
+        members = [a for a in dict.fromkeys(member_addrs)
+                   if a and a != self.serve_address]
+        plan = balanced_plan(members, self.serve_address, fanout)
+        bcast_id = f"{oid.hex()[:16]}.p{id(plan) & 0xffffff:x}"
+        futs = []
+        failed = []
+        for addr in plan.order:
+            sources = [a for a in plan.fallbacks(addr) if a != addr]
+            try:
+                fut = self._peer(addr).call_async(
+                    "bc_begin", bcast_id, oid.binary(), size,
+                    tuple(sources), chunk)
+            except Exception:   # noqa: BLE001 — member unreachable
+                self._drop_peer(addr)
+                failed.append(addr)
+                continue
+            futs.append((addr, fut))
+        per_member = timeout if timeout is not None else \
+            cfg.broadcast_fetch_timeout_s + max(60.0, size / (1 << 20))
+        reached = []
+        for addr, fut in futs:
+            try:
+                res = fut.result(per_member)
+                ok = bool(res.get("ok"))
+            except Exception:   # noqa: BLE001 — member died mid-session
+                self._drop_peer(addr)
+                ok = False
+            (reached if ok else failed).append(addr)
+        return {"ok": not failed, "reached": reached, "failed": failed}
 
     def request_remote_pull(self, dest_addr: str, oid: ObjectID,
                             size: int, src_addr: str,

@@ -55,6 +55,10 @@ class PullManager:
         self._seq = 0
         self._active: deque = deque()       # (key, src_row) awaiting transfer
         self._inflight_bytes = 0
+        # per-SOURCE-row bytes in flight (KB), feeding the cost model's
+        # derating input: the fix for concurrent pulls all piling onto
+        # the same "cheapest" replica.  Guarded by ``self._cv``.
+        self._infl_kb_rows: dict[int, int] = {}
         self._stop = False
         self._threads: list[threading.Thread] = []
         # stats
@@ -178,11 +182,18 @@ class PullManager:
                 # no live copy anywhere: the object is lost
                 self._fail_locked(key)
                 continue
-            self._active.append((key, int(src)))
+            src = int(src)
+            req = self._requests[key]
+            req["src_row"] = src
+            self._infl_kb_rows[src] = self._infl_kb_rows.get(src, 0) \
+                + max(req["size"] >> 10, 1)
+            self._active.append((key, src))
         self._cv.notify_all()
 
     def _choose_sources(self, keys: list[tuple]) -> np.ndarray:
-        """Best source per request via the bandwidth cost model."""
+        """Best source per request via the bandwidth cost model, derated
+        by the bytes already in flight FROM each candidate (caller holds
+        the lock, so the ledger snapshot is consistent with the batch)."""
         directory = self._cluster.directory
         bw = self._cluster.bandwidth_mbps
         n = bw.shape[0]
@@ -192,15 +203,42 @@ class PullManager:
             [max(self._requests[k]["size"] >> 10, 1) for k in keys],
             dtype=np.int32)
         loc = directory.location_matrix(oids, n)
+        infl = self._inflight_kb_locked(n)
         if len(keys) >= self._device_min:
             from ..ops.pull_kernel import choose_sources_np
             self.device_batches += 1
-            src, _cost = choose_sources_np(loc, bw, dest, sizes_kb)
+            src, _cost = choose_sources_np(loc, bw, dest, sizes_kb, infl)
         else:
             from ..ops.pull_kernel import choose_sources_oracle
             self.oracle_batches += 1
-            src, _cost = choose_sources_oracle(loc, bw, dest, sizes_kb)
+            src, _cost = choose_sources_oracle(loc, bw, dest, sizes_kb,
+                                               infl)
         return src
+
+    def _inflight_kb_locked(self, n: int) -> np.ndarray:
+        infl = np.zeros(n, dtype=np.int32)
+        for row, kb in self._infl_kb_rows.items():
+            if 0 <= row < n:
+                infl[row] = min(kb, 2**31 - 1)
+        return infl
+
+    def inflight_kb(self, n: int) -> np.ndarray:
+        """Per-source-row KB in flight — the broadcast coordinator feeds
+        this into its fan-out kernel so tree shaping sees pull load."""
+        with self._cv:
+            return self._inflight_kb_locked(n)
+
+    def _release_src_locked(self, req: dict) -> None:
+        """Return an activated request's bytes to its source row's
+        in-flight ledger (caller holds the lock)."""
+        src = req.pop("src_row", None)
+        if src is None:
+            return
+        left = self._infl_kb_rows.get(src, 0) - max(req["size"] >> 10, 1)
+        if left > 0:
+            self._infl_kb_rows[src] = left
+        else:
+            self._infl_kb_rows.pop(src, None)
 
     def _fail_locked(self, key: tuple) -> None:
         req = self._requests.pop(key, None)
@@ -208,6 +246,7 @@ class PullManager:
             return
         if req["active"]:
             self._inflight_bytes -= req["size"]
+            self._release_src_locked(req)
         self.num_failed += 1
         cbs = req["callbacks"]
         if cbs:
@@ -246,6 +285,7 @@ class PullManager:
                     _clk.sleep(0.2 * req["attempts"])
                     with self._cv:
                         self._inflight_bytes -= req["size"]
+                        self._release_src_locked(req)
                         dup = self._requests.get(key)
                         if dup is not None:
                             # a fresh request for the same key arrived
@@ -269,6 +309,7 @@ class PullManager:
                 self._cluster.directory.add_location(oid, dest)
             with self._cv:
                 self._inflight_bytes -= req["size"]
+                self._release_src_locked(req)
                 if ok:
                     self.num_pulls += 1
                     self.bytes_pulled += req["size"]
@@ -288,6 +329,12 @@ class PullManager:
         directory replica's plane address rides along: the destination
         plane stripes chunk ranges across them (and fails over within
         the transfer when the primary dies mid-stripe)."""
+        # an ACTIVE broadcast of this object grafts the pull onto the
+        # relay tree (one leaf join) instead of opening an independent
+        # stream against the cost model's favorite replica
+        broadcasts = getattr(self._cluster, "broadcasts", None)
+        if broadcasts is not None and broadcasts.join(oid, dest):
+            return True
         planes = self._cluster.planes
         src_addr = planes.get(src)
         dest_addr = planes.get(dest)
@@ -343,6 +390,7 @@ class PullManager:
                 "num_failed": self.num_failed,
                 "queued": len(self._requests),
                 "inflight_bytes": self._inflight_bytes,
+                "inflight_sources": len(self._infl_kb_rows),
                 "device_batches": self.device_batches,
                 "oracle_batches": self.oracle_batches,
             }

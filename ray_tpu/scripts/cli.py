@@ -242,6 +242,23 @@ def cmd_status(args) -> int:
               f"({pulls['bytes_pulled'] / (1 << 20):.1f} MB), "
               f"{pulls['num_failed']} failed, {pulls['queued']} queued, "
               f"{pulls['inflight_bytes'] / (1 << 20):.1f} MB in flight")
+    bc = st.get("broadcasts")
+    if bc:
+        print(f"broadcasts: {bc['bcast_active_trees']} active, "
+              f"{bc['bcast_trees_completed']} done, "
+              f"{bc['bcast_trees_failed']} degraded; "
+              f"{bc['bcast_members_reached']} replicas via tree "
+              f"(+{bc['bcast_members_fallback']} pull fallback, "
+              f"{bc['bcast_joins']} pull joins)")
+        if bc.get("bcast_trees_started"):
+            print(f"  relay fanout {bc['bcast_relay_fanout']}  "
+                  f"time-to-all ewma {bc['bcast_time_to_all_ewma_s']}s")
+        op2 = st.get("object_plane") or {}
+        if op2.get("bcast_chunks_pulled") or \
+                op2.get("bcast_chunks_relayed"):
+            print(f"  chunks relayed={op2['bcast_chunks_relayed']} "
+                  f"pulled={op2['bcast_chunks_pulled']} "
+                  f"sealed-served={op2['bcast_chunks_sealed_served']}")
     if st["jobs"]:
         print(f"jobs ({len(st['jobs'])}):")
         for j in st["jobs"]:

@@ -30,7 +30,7 @@ __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
            "build_schedule"]
 
 CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
-             "drain_churn", "autoscaler_flap")
+             "drain_churn", "autoscaler_flap", "broadcast_storm")
 
 _SETTLE_CAP_S = 900.0       # virtual budget for the quiesce phase
 
@@ -111,6 +111,10 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
         "drain_churn": (("drain", 0.7), ("kill_node", 0.3)),
         "autoscaler_flap": (("drain", 0.4), ("kill_node", 0.4),
                             ("gray_slow", 0.2)),
+        # weight-distribution waves racing relay-node/root kills and
+        # gray links: the broadcast plane's re-parenting under fire
+        "broadcast_storm": (("broadcast", 0.45), ("kill_node", 0.3),
+                            ("gray_slow", 0.15), ("kill_head", 0.1)),
     }
     ops, weights = zip(*mixes[campaign])
     sched = []
@@ -152,6 +156,17 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
             sched.append((t, "gray_slow", {"addr": addr}))
             sched.append((t + heal_after, "gray_heal", {"addr": addr}))
             continue
+        if op == "broadcast":
+            count = int(rng.integers(max(2, num_nodes // 3),
+                                     num_nodes + 1))
+            rows = sorted(int(x) for x in rng.choice(
+                num_nodes, size=min(count, num_nodes), replace=False))
+            sched.append((t, "broadcast", {
+                "members": [f"n{r:05d}" for r in rows],
+                "size_mb": int(rng.integers(64, 1025)),
+                "fanout": int(rng.integers(2, 5)),
+            }))
+            continue
         sched.append((t, op, {"node": f"n{target:05d}"}))
     sched.sort(key=lambda e: e[0])
     return jobs, sched
@@ -183,6 +198,7 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         if not lockorder.installed():
             lockorder.install()
     acked: list[str] = []
+    waves: list = []            # SimBroadcastWave, launch order
     completed_cache = {"n": 0}
     fault_count = {"n": 0}
     inv_checks = {"n": 0}
@@ -214,6 +230,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         t = clock.monotonic()
         if op == "kill_head":
             cluster.kill_head()
+            for w in waves:
+                w.on_node_killed("head")
             trace.rec(t, "fault", op=op)
         elif op == "restart_head":
             if cluster.head is None:
@@ -221,7 +239,20 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
             trace.rec(t, "fault", op=op)
         elif op == "kill_node":
             hit = cluster.kill_node(kw["node"])
+            if hit:
+                for w in waves:
+                    w.on_node_killed(kw["node"])
             trace.rec(t, "fault", op=op, node=kw["node"], hit=hit)
+        elif op == "broadcast":
+            from .broadcast import SimBroadcastWave
+            w = SimBroadcastWave(cluster, f"w{len(waves)}",
+                                 kw["members"], size_mb=kw["size_mb"],
+                                 fanout=kw["fanout"])
+            waves.append(w)
+            w.start()
+            trace.rec(t, "fault", op=op, wave=w.wave_id,
+                      members=len(kw["members"]),
+                      size_mb=kw["size_mb"], fanout=kw["fanout"])
         elif op == "drain":
             ok = False
             if cluster.head is not None and cluster.head.alive:
@@ -278,12 +309,28 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                            if head.jobs.get(jid, {}).get("status") ==
                            "succeeded")
                 completed_cache["n"] = done
-                return done == len(acked)
+                return done == len(acked) and \
+                    all(w.terminal for w in waves)
 
             settle_end = duration + _SETTLE_CAP_S
             while not all_done() and clock.monotonic() < settle_end:
                 clock.advance(cluster.params.heartbeat_period_s)
             check("final")
+            # broadcast waves: every wave terminal, every live member
+            # holding a full replica (re-parenting converged, no lost
+            # chunks — a completed member received every chunk exactly
+            # once by construction of the delivery model)
+            for w in waves:
+                if not w.terminal:
+                    violations.append(
+                        f"[final] broadcast wave {w.wave_id} never "
+                        f"became terminal")
+                    continue
+                left = w.unreached_live()
+                if left:
+                    violations.append(
+                        f"[final] broadcast wave {w.wave_id}: "
+                        f"{len(left)} live members without a replica")
             v, n = check_invariants(cluster, acked, strict=True)
             inv_checks["n"] += n
             trace.rec(clock.monotonic(), "invariant_check",

@@ -90,6 +90,40 @@ class TestPullKernel:
             loc, bw, np.array([3], np.int32), np.array([100], np.int32))
         assert src.tolist() == [1]
 
+    def test_inflight_load_splits_concurrent_pulls(self):
+        """Regression: per-link in-flight MB feeds the cost inputs.
+        Two concurrent 64 MB pulls of a twice-replicated object must
+        pick DIFFERENT sources — the first activation's bytes derate
+        its replica below the runner-up."""
+        n = 4
+        loc = np.zeros((2, n), dtype=bool)
+        loc[:, 1] = loc[:, 2] = True        # replicas on rows 1 and 2
+        bw = np.ones((n, n), dtype=np.int32)
+        bw[1, 3] = 10_000                   # row 1 is the clear favorite
+        bw[2, 3] = 9_000
+        dest = np.array([3, 3], np.int32)
+        sizes = np.full(2, 64 * 1024, np.int32)     # 64 MB each
+        src, _ = choose_sources_oracle(loc, bw, dest, sizes)
+        assert src.tolist() == [1, 2]
+        got, _ = choose_sources_np(loc, bw, dest, sizes)
+        np.testing.assert_array_equal(got, src)
+
+    def test_device_matches_oracle_with_inflight(self, rng):
+        """Parity with a nonzero starting ledger (the pull manager's
+        ``inflight_kb`` vector feeding both backends)."""
+        for n, r in [(8, 6), (32, 40), (64, 128)]:
+            loc = rng.random((r, n)) < 0.4
+            bw = rng.integers(1, 100_000, size=(n, n)).astype(np.int32)
+            dest = rng.integers(0, n, size=r).astype(np.int32)
+            sizes = rng.integers(1, 1 << 17, size=r).astype(np.int32)
+            infl = rng.integers(0, 1 << 18, size=n).astype(np.int32)
+            want_src, want_cost = choose_sources_oracle(
+                loc, bw, dest, sizes, infl)
+            got_src, got_cost = choose_sources_np(
+                loc, bw, dest, sizes, infl)
+            np.testing.assert_array_equal(got_src, want_src)
+            np.testing.assert_array_equal(got_cost, want_cost)
+
 
 # -- pull manager ----------------------------------------------------------
 

@@ -1,8 +1,9 @@
 """W5 seam discipline: control-plane code must not bypass the clock
 and transport seams.
 
-Two checks, scoped to ``ray_tpu/runtime/`` and ``ray_tpu/rpc/`` (the
-code the in-process simulator runs under a virtual clock):
+Two checks, scoped to ``ray_tpu/runtime/``, ``ray_tpu/rpc/`` and
+``ray_tpu/broadcast/`` (the code the in-process simulator runs under a
+virtual clock):
 
 - **clock bypass**: a direct call to ``time.time()``,
   ``time.monotonic()`` or ``time.sleep()`` — including through an
@@ -14,7 +15,8 @@ code the in-process simulator runs under a virtual clock):
   _clk.sleep()``).  ``time.perf_counter`` and friends stay legal:
   measuring *real* elapsed wall time (benchmarks, logs of actual
   latency) is not a control-plane deadline.
-- **transport bypass** (``ray_tpu/runtime/`` only): constructing
+- **transport bypass** (``ray_tpu/runtime/`` and
+  ``ray_tpu/broadcast/``): constructing
   ``RpcClient(...)``/``RpcServer(...)`` directly instead of going
   through ``rpc.transport.connect()/serve()`` welds that control path
   to real sockets and cuts it out of the simulator.  The ``rpc/``
@@ -34,8 +36,8 @@ import re
 from .finding import Finding
 
 _CLOCK_FNS = ("time", "monotonic", "sleep")
-_SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/")
-_TRANSPORT_SCOPE = "ray_tpu/runtime/"
+_SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/")
+_TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/")
 _EXEMPT = ("ray_tpu/common/clock.py", "ray_tpu/rpc/transport.py")
 
 
