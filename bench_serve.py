@@ -1,7 +1,15 @@
-"""Serve request-plane benchmark: micro-batching and overload shedding.
+"""Serve request-plane benchmark: diurnal scale, batching, shedding.
 
-Two experiments against a live single-node cluster:
+Three experiments, mirroring bench.py's smoke-first discipline (a JSON
+record always lands, even if the live cluster hangs):
 
+- **diurnal** (the smoke stage, disposable subprocess): the 1k-node
+  simulated ``serve_diurnal`` campaign — a cosine day/night arrival
+  curve with chaos faults — run twice, single-router vs 8-sharded
+  routers, same seed.  The SLO report checks the sharding bar (sharded
+  accepted QPS >= 3x single at equal-or-better p99), zero
+  accepted-request loss, and that elastic capacity loans fired and
+  reclaimed in well under a cold boot.  Written to ``SERVE_r10.json``.
 - **batching**: a model that admits ONE inference stream (a lock around
   a fixed ~8 ms compute step) served unbatched vs through
   ``@serve.batch`` — the batcher amortizes the per-invocation cost
@@ -12,10 +20,13 @@ Two experiments against a live single-node cluster:
   must SHED the excess (503 + Retry-After) while the p99 latency of the
   ACCEPTED requests stays bounded by queue depth, not by offered load.
 
-Prints exactly one JSON line.
+Prints one JSON line per stage (smoke, then the live headline) and
+writes the full round record to ``SERVE_r10.json``.
 """
 
 import json
+import os
+import sys
 import threading
 import time
 
@@ -24,6 +35,116 @@ STEP_S = 0.008          # per-invocation model cost
 HTTP_SECONDS = 2.5      # overload measurement window
 HTTP_CLIENTS = 16
 
+SIM_NODES = 1000
+SIM_SEED = 3
+SIM_FAULTS = 12
+SIM_DURATION = 150.0
+SHARD_CONFIGS = (1, 8)
+
+RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "SERVE_r10.json")
+
+
+# -- diurnal sim campaign (the smoke stage) -----------------------------------
+
+def diurnal_bench() -> dict:
+    """1k-node serve_diurnal campaign, single-router vs sharded, same
+    seed/faults — the only variable is ``serve_router_shards``."""
+    from ray_tpu.sim import run_campaign
+    from ray_tpu.sim.serve import SimServeParams
+
+    runs = {}
+    for shards in SHARD_CONFIGS:
+        r = run_campaign(
+            SIM_NODES, seed=SIM_SEED, campaign="serve_diurnal",
+            faults=SIM_FAULTS, duration=SIM_DURATION,
+            serve={"params": SimServeParams(num_shards=shards)})
+        assert r.ok, (shards, r.violations)
+        runs[shards] = r.stats["serve"]
+    single, sharded = runs[SHARD_CONFIGS[0]], runs[SHARD_CONFIGS[-1]]
+    gain = sharded["accepted"] / max(single["accepted"], 1)
+    slo = {
+        "accepted_qps_gain": round(gain, 2),
+        "qps_gain_ok": sharded["accepted"] >= 3 * single["accepted"],
+        "p99_ok": sharded["p99_s"] <= single["p99_s"],
+        # conservation: every admitted request completed (death requeues
+        # count as redispatched, never as loss)
+        "zero_accepted_loss": (
+            sharded["accepted"] == sharded["completed"]
+            and sharded["outstanding"] == 0),
+        "loans_fired": (sharded["loans_total"] > 0
+                        and sharded["reclaims_total"] > 0),
+        # a reclaimed loaner is batch capacity again in under the time a
+        # cold replacement node would still be booting
+        "reclaim_beats_cold_start": (
+            0.0 < sharded["mean_reclaim_s"] < sharded["cold_start_s"]),
+    }
+    return {
+        "nodes": SIM_NODES, "seed": SIM_SEED, "faults": SIM_FAULTS,
+        "duration_s": SIM_DURATION,
+        "single_router": single, "sharded_router": sharded,
+        "slo": slo, "slo_pass": all(slo.values()),
+    }
+
+
+def _emit_smoke() -> None:
+    """The --smoke entry: run the diurnal pair in this disposable
+    subprocess and print exactly one JSON line."""
+    d = diurnal_bench()
+    flags = "" if d["slo_pass"] else " [SLO FAIL: " + ", ".join(
+        k for k, v in d["slo"].items() if not v) + "]"
+    print(json.dumps({
+        "metric": f"serve diurnal 1k-node sim: {SHARD_CONFIGS[-1]}-shard "
+                  f"accepted {d['slo']['accepted_qps_gain']}x single-"
+                  f"router at p99 {d['sharded_router']['p99_s']}s vs "
+                  f"{d['single_router']['p99_s']}s" + flags,
+        "value": d["slo"]["accepted_qps_gain"],
+        "unit": "x",
+        "vs_baseline": d["slo"]["accepted_qps_gain"],
+        "status": "smoke",
+        "diurnal": d,
+    }), flush=True)
+
+
+def _smoke_first() -> dict | None:
+    """Run the diurnal stage in a subprocess (a hung backend cannot eat
+    the record), print its JSON line, and seed SERVE_r10.json so the
+    round's record exists before the live cluster starts."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    err = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env)
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if proc.returncode == 0 and lines:
+            print(lines[-1], flush=True)
+            record = json.loads(lines[-1])
+            _write_record(record.get("diurnal"), live=None)
+            return record.get("diurnal")
+        err = f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        err = "smoke subprocess exceeded 600s"
+    print(json.dumps({
+        "metric": f"serve diurnal smoke FAILED [{err}]",
+        "value": -1.0, "unit": "x", "vs_baseline": 0.0,
+        "status": "smoke_failed"}), flush=True)
+    _write_record(None, live=None, error=err)
+    return None
+
+
+def _write_record(diurnal, live, error: str = "") -> None:
+    doc = {"format": "ray_tpu-serve-bench/1", "round": 10,
+           "diurnal": diurnal, "live": live}
+    if error:
+        doc["error"] = error
+    with open(RECORD, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# -- live experiments ---------------------------------------------------------
 
 def _throughput(handle, n=N_REQUESTS) -> float:
     import ray_tpu
@@ -35,7 +156,6 @@ def _throughput(handle, n=N_REQUESTS) -> float:
 
 
 def bench_batching() -> tuple[float, float]:
-    import ray_tpu
     from ray_tpu import serve
 
     @serve.deployment(num_replicas=1, max_ongoing_requests=16)
@@ -134,6 +254,9 @@ def bench_overload() -> dict:
 
 
 def main():
+    # invariant: the SLO record exists before anything can hang
+    diurnal = _smoke_first()
+
     import ray_tpu
     ray_tpu.init(resources={"CPU": 12, "memory": 8}, num_workers=6)
     try:
@@ -145,6 +268,14 @@ def main():
         ray_tpu.shutdown()
 
     speedup = batched / unbatched
+    live = {
+        "unbatched_rps": round(unbatched, 1),
+        "batched_rps": round(batched, 1),
+        "batching_speedup": round(speedup, 2),
+        "overload": {k: round(v, 3) if isinstance(v, float) else v
+                     for k, v in http.items()},
+    }
+    _write_record(diurnal, live)
     print(json.dumps({
         "metric": f"serve: unbatched {unbatched:.0f} | batched "
                   f"{batched:.0f} req/s"
@@ -162,4 +293,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        _emit_smoke()
+    else:
+        main()

@@ -108,6 +108,12 @@ class StandardAutoscaler:
         with self._lock:
             launches = self._scale_up()
             terminated = self._scale_down()
+        # capacity loaning rides the autoscaler beat: batch pressure
+        # (unmet demand) is the reclaim trigger, serve backlog the loan
+        # trigger — both are read inside the manager's own tick
+        loans = getattr(self._cluster, "loans", None)
+        if loans is not None:
+            loans.tick(unmet=self.last_unmet)
         return {"launches": launches, "terminated": terminated,
                 "unmet": self.last_unmet}
 
@@ -215,6 +221,7 @@ class StandardAutoscaler:
         now = _clk.monotonic()
         totals, avail, mask = cluster.crm.arrays()
         drain_mask = cluster.crm.draining
+        loan_mask = cluster.crm.loaned
         terminated = []
         rows = [(row, r) for row, r in list(cluster.raylets.items())
                 if row != cluster._head_row]
@@ -224,7 +231,10 @@ class StandardAutoscaler:
         leaving = sum(1 for row, _ in rows if drain_mask[row])
         requested = list(getattr(self, "_requested", ()))
         for row, raylet in rows:
-            if drain_mask[row]:
+            if drain_mask[row] or loan_mask[row]:
+                # LOANED rows belong to the serve plane until the loan
+                # manager reclaims them: neither idle-terminate nor
+                # surplus-drain may take them out from under a replica
                 self._idle_since.pop(raylet.node_id, None)
                 self._surplus_since.pop(raylet.node_id, None)
                 continue

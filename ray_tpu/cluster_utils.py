@@ -144,6 +144,10 @@ class Cluster:
         from .runtime.health import HealthCheckManager
         self.health = HealthCheckManager(self)
         self.health.start()
+        # elastic serve<->batch capacity loaning (LOANED rows atop the
+        # CRM); ticked from the autoscaler round and the health round
+        from .serve.loaning import CapacityLoanManager
+        self.loans = CapacityLoanManager(self)
         port = get_config().metrics_export_port
         self.metrics = None
         if port:

@@ -341,6 +341,44 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         float, 0.2,
         "Smoothing factor for the per-deployment request-latency EWMA "
         "the router feeds the autoscaler (higher = more reactive)."),
+    "serve_router_shards": (
+        int, 1,
+        "Router shards per deployment (the per-ingress router model): "
+        "sessions consistent-hash onto shards, each shard routes p2c on "
+        "its own counts plus the gossiped load digests of its peers. "
+        "1 keeps the single-router behavior; raise it to remove the "
+        "central router as the request-plane bottleneck."),
+    "serve_gossip_interval_s": (
+        float, 0.25,
+        "Maximum staleness of the folded per-replica load digests the "
+        "router shards route on.  Folds piggyback on the health "
+        "manager's probe round and happen opportunistically at pick "
+        "time when the merged view is older than this.  Staleness can "
+        "only over-queue at a replica, never over-RUN it: the replica "
+        "cap is enforced replica-side by max_concurrency."),
+    # -- serve<->batch capacity loaning -------------------------------------
+    "serve_loan_max_nodes": (
+        int, 2,
+        "Maximum batch nodes loaned to the serve plane concurrently "
+        "(tracked LOANED atop the CRM); 0 disables loaning."),
+    "serve_loan_backlog": (
+        int, 8,
+        "Queued-request backlog (summed across a deployment's router "
+        "shards) that, together with an exhausted replica pool, "
+        "triggers borrowing an idle batch node."),
+    "serve_loan_cooldown_s": (
+        float, 2.0,
+        "Minimum spacing between consecutive loans, so one backlog "
+        "spike cannot strip the whole batch pool at once."),
+    "serve_loan_reclaim_idle_s": (
+        float, 5.0,
+        "How long a deployment must stay backlog-free before its "
+        "loaned nodes are voluntarily returned to the batch pool."),
+    "serve_loan_drain_timeout_s": (
+        float, 10.0,
+        "Reclaim drain deadline: a loaner replica still busy past this "
+        "is force-killed so the node returns to the batch pool (the "
+        "DRAINING machine's preemption-notice semantics)."),
     # -- concurrency invariants (rtlint) ------------------------------------
     "rtlint_runtime_lock_order": (
         bool, False,

@@ -90,11 +90,21 @@ class Dashboard(BackgroundHTTPServer):
         if name == "jobs":
             return self._jobs.list() if self._jobs is not None else []
         if name == "serve":
+            out = {}
             try:
                 from ..serve.router import request_plane_stats
-                return request_plane_stats()
+                out["deployments"] = request_plane_stats()
             except Exception:   # noqa: BLE001 — serve absent/unused
-                return {}
+                out["deployments"] = {}
+            try:
+                from ..serve.gossip import board
+                out["gossip"] = board.stats()
+            except Exception:   # noqa: BLE001
+                pass
+            loans = getattr(self._cluster, "loans", None)
+            if loans is not None:
+                out["loans"] = loans.stats()
+            return out
         if name == "broadcasts":
             cluster = self._cluster
             out = {}
@@ -196,9 +206,19 @@ class Dashboard(BackgroundHTTPServer):
                     sorted(plane.items())]
             sections += [
                 "<h2>Serve request plane</h2>",
-                table(rows, ["deployment", "replicas", "inflight",
-                             "queued", "qps", "p50_ms", "p99_ms",
-                             "shed", "expired", "batch_size_mean"])]
+                table(rows, ["deployment", "replicas", "shards",
+                             "inflight", "queued", "qps", "p50_ms",
+                             "p99_ms", "shed", "expired",
+                             "batch_size_mean"])]
+            loans = getattr(self._cluster, "loans", None)
+            if loans is not None:
+                ls = loans.stats()
+                sections.append(
+                    f"<p>capacity loans: {ls['loans_active']} active · "
+                    f"{ls['loans_total']} taken · "
+                    f"{ls['reclaims_total']} reclaimed · "
+                    f"{ls['loans_lost']} lost · last reclaim "
+                    f"{ls['last_reclaim_latency_s']}s</p>")
         sections.append(
             '<p>APIs: <a href="/api/summary">summary</a> · '
             '<a href="/api/nodes">nodes</a> · '

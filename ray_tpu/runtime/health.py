@@ -130,6 +130,22 @@ class HealthCheckManager:
         live = {r.node_id for r in cluster.raylets.values()}
         for nid in [n for n in self._state if n not in live]:
             del self._state[nid]
+        # serve-plane piggyback: router load digests fold onto the
+        # gossip board on the same beat that carries node liveness (no
+        # extra RPC), and the capacity-loan state machine advances —
+        # including the node-death loss booking for LOANED rows
+        try:
+            from ..serve.gossip import fold_all
+            fold_all()
+        except Exception:   # noqa: BLE001 — gossip is best-effort
+            pass
+        loans = getattr(cluster, "loans", None)
+        if loans is not None:
+            try:
+                loans.tick()
+            except Exception:   # noqa: BLE001 — monitor must survive
+                import traceback
+                traceback.print_exc()
         return declared
 
     def stats(self) -> dict:

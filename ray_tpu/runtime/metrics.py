@@ -215,6 +215,42 @@ def render_metrics(cluster) -> str:
                  "Micro-batches executed (cumulative)", lbl, out)
             _fmt("serve_batch_size_mean", s["batch_size_mean"],
                  "Mean micro-batch size", lbl, out)
+        _fmt("serve_router_shards", s.get("shards", 1),
+             "Router shards for this deployment's request plane", lbl,
+             out)
+        _fmt("serve_gossip_digest_size", s.get("gossip_digest", 0),
+             "Replica load entries on the gossip board", lbl, out)
+
+    # gossiped load board (process-local, shared by every deployment)
+    try:
+        from ..serve.gossip import board
+        gs = board.stats()
+        _fmt("serve_gossip_folds_total", gs["folds"],
+             "Shard-digest folds onto the load board (cumulative)",
+             out=out)
+        _fmt("serve_gossip_evictions_total", gs["evicted_replicas"],
+             "Replica entries evicted on membership change "
+             "(cumulative)", out=out)
+    except Exception:   # noqa: BLE001 — serve absent/unused
+        pass
+
+    # elastic serve<->batch capacity loaning
+    loans = getattr(cluster, "loans", None)
+    if loans is not None:
+        ls = loans.stats()
+        _fmt("serve_loans_active", ls["loans_active"],
+             "Batch nodes currently loaned to the serve plane", out=out)
+        _fmt("serve_loans_total", ls["loans_total"],
+             "Capacity loans taken (cumulative)", out=out)
+        _fmt("serve_loan_reclaims_total", ls["reclaims_total"],
+             "Loans reclaimed through drain semantics (cumulative)",
+             out=out)
+        _fmt("serve_loans_lost_total", ls["loans_lost"],
+             "Loaned nodes lost to failure, booked once (cumulative)",
+             out=out)
+        _fmt("serve_loan_last_reclaim_seconds",
+             ls["last_reclaim_latency_s"],
+             "Drain-to-restore latency of the last reclaim", out=out)
 
     # user-defined metrics (ray_tpu.util.metrics) share the endpoint
     from ..util.metrics import render_user_metrics

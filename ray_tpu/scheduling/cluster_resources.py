@@ -65,6 +65,12 @@ class ClusterResourceManager:
         # fits, but fall back to them rather than parking feasible work
         # — unlike draining, suspect never hides a node from snapshot()
         self.suspect = np.zeros(self._capacity, dtype=bool)
+        # LOANED rows are batch nodes lent to the serve plane: they stay
+        # in the placement mask, but the loan manager force-subtracts all
+        # generic availability and exposes a shaped "serve_loaned"
+        # resource only loaner replicas request — batch work cannot fit
+        # until the loan is reclaimed and the availability restored
+        self.loaned = np.zeros(self._capacity, dtype=bool)
         self._row_of: dict[NodeID, int] = {}
         self._id_of: dict[int, NodeID] = {}
         self._labels: dict[int, dict[str, str]] = {}
@@ -116,6 +122,7 @@ class ClusterResourceManager:
             self.node_mask[row] = True
             self.draining[row] = False
             self.suspect[row] = False
+            self.loaned[row] = False
             self._row_of[node_id] = row
             self._id_of[row] = node_id
             self._labels[row] = dict(resources.labels)
@@ -134,6 +141,9 @@ class ClusterResourceManager:
             self.node_mask[row] = False
             self.draining[row] = False
             self.suspect[row] = False
+            # rows are reused by _alloc_row — a stale loaned bit would
+            # hide the next tenant of this row from the loan picker
+            self.loaned[row] = False
             self._mark(row)
 
     # -- drain lifecycle (ALIVE -> DRAINING -> removed) ---------------------
@@ -178,6 +188,28 @@ class ClusterResourceManager:
             return [int(r) for r in
                     np.flatnonzero(self.node_mask & self.suspect)]
 
+    # -- loan lifecycle (batch node lent to the serve plane) ----------------
+    def set_loaned(self, row: int, flag: bool = True) -> None:
+        """Mark/unmark a row as loaned to serve.  Loaned rows stay in
+        the placement mask — batch is kept off them by availability
+        (force-subtracted to zero), not by masking, so the drain/restore
+        epilogue is a plain add_back."""
+        with self._lock:
+            if 0 <= row < self._capacity and \
+                    bool(self.loaned[row]) != flag:
+                self.loaned[row] = flag
+                self._mark(row)
+
+    def is_loaned(self, row: int) -> bool:
+        with self._lock:
+            return bool(self.loaned[row]) if 0 <= row < self._capacity \
+                else False
+
+    def loaned_rows(self) -> list[int]:
+        with self._lock:
+            return [int(r) for r in
+                    np.flatnonzero(self.node_mask & self.loaned)]
+
     def _alloc_row(self) -> int:
         free = np.flatnonzero(~self.node_mask)
         # prefer rows never used / lowest index: deterministic traversal order
@@ -204,6 +236,9 @@ class ClusterResourceManager:
         sus = np.zeros(cap, dtype=bool)
         sus[:self._capacity] = self.suspect
         self.suspect = sus
+        loan = np.zeros(cap, dtype=bool)
+        loan[:self._capacity] = self.loaned
+        self.loaned = loan
         self._capacity = cap
         self._mark_struct()
 

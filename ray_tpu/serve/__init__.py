@@ -17,10 +17,11 @@ from .deployment import (Application, Deployment, DeploymentHandle,
                          get_multiplexed_model_id, http_address,
                          multiplexed, run, shutdown, start, status)
 from .http_proxy import HTTPRequest
-from .router import RequestRouter
+from .router import RequestRouter, RouterGroup
 
 __all__ = ["Application", "BackPressureError", "batch", "Deployment",
            "DeploymentHandle", "delete", "deployment",
            "get_deployment_handle", "get_multiplexed_model_id",
            "http_address", "HTTPRequest", "multiplexed",
-           "RequestRouter", "run", "shutdown", "start", "status"]
+           "RequestRouter", "RouterGroup", "run", "shutdown", "start",
+           "status"]

@@ -229,6 +229,8 @@ class _Controller:
         self._kv_base = os.urandom(6).hex()
         self._kv_key = f"inflight-{self._kv_base}"
         self._replicas: list = []
+        self._loaners: list = []    # replicas on LOANED batch nodes
+        self._retiring: list = []   # loaners draining for reclaim
         self._version = 0
         self._last_scale = time.monotonic()
         if autoscaling:
@@ -260,12 +262,72 @@ class _Controller:
 
     # -- handle-facing -------------------------------------------------------
     def get_replicas(self):
-        return self._version, list(self._replicas), self._kv_key, {
-            "max_ongoing": self._max_ongoing,
-            "max_queued": self._max_queued,
-            "name": self._name,
-            "base": self._kv_base,
-        }
+        auto = self._autoscaling
+        hi = auto.get("max_replicas", 4) if auto else \
+            len(self._replicas)
+        return (self._version, list(self._replicas) + list(self._loaners),
+                self._kv_key, {
+                    "max_ongoing": self._max_ongoing,
+                    "max_queued": self._max_queued,
+                    "name": self._name,
+                    "base": self._kv_base,
+                    # the loan manager's "pool exhausted" signal: the
+                    # regular pool cannot grow past its configured cap
+                    "at_max": len(self._replicas) >= hi,
+                    "loaners": len(self._loaners),
+                })
+
+    # -- elastic capacity loaning (driver LoanManager calls these) -----------
+    def add_loaner(self, actor_options: dict):
+        """Start one replica on a LOANED batch node: the options carry
+        the loan-shaped resource (``serve_loaned``) that only loaned
+        CRM rows expose, so placement lands there and nowhere else.
+        Returns the replica handle — the loan record keeps it for the
+        targeted reclaim drain."""
+        import ray_tpu
+        actor_cls = ray_tpu.remote(_ReplicaShell)
+        opts = dict(self._actor_options)
+        opts.update(actor_options)
+        opts.setdefault("max_concurrency", self._max_ongoing)
+        handle = actor_cls.options(**opts).remote(
+            self._target_bytes, self._init_args_bytes, self._kv_key)
+        self._loaners.append(handle)
+        self._version += 1
+        return handle
+
+    def begin_retire_loaner(self, key_hex: str = ""):
+        """Reclaim step 1: pull one loaner out of the routing set
+        (version bump -> shards stop dispatching to it) but keep it
+        alive to finish in-flight work.  ``key_hex`` targets a specific
+        replica (node death); empty retires the newest loan (LIFO)."""
+        pick = None
+        if key_hex:
+            for h in self._loaners:
+                if h._actor_id.binary().hex() == key_hex:
+                    pick = h
+                    break
+        elif self._loaners:
+            pick = self._loaners[-1]
+        if pick is None:
+            return None
+        self._loaners.remove(pick)
+        self._retiring.append(pick)
+        self._version += 1
+        return pick
+
+    def finish_retire_loaner(self, key_hex: str) -> bool:
+        """Reclaim step 2: the drain converged (or timed out, or the
+        node died) — kill the retiring replica and forget it."""
+        import ray_tpu
+        for h in list(self._retiring):
+            if h._actor_id.binary().hex() == key_hex:
+                self._retiring.remove(h)
+                try:
+                    ray_tpu.kill(h)
+                except Exception:   # noqa: BLE001 — already dead
+                    pass
+                return True
+        return False
 
     def ensure_replica(self):
         """Cold start for scale-to-zero: a request arrived while no
@@ -333,7 +395,7 @@ class _Controller:
             self._last_scale = now
 
     def num_replicas(self) -> int:
-        return len(self._replicas)
+        return len(self._replicas) + len(self._loaners)
 
     def stats(self) -> dict:
         """Controller-side view of the request-plane load signals
@@ -342,14 +404,30 @@ class _Controller:
         inflight, queued, lat_ms = self._signals()
         return {"deployment": self._name,
                 "replicas": len(self._replicas),
+                "loaners": len(self._loaners),
                 "inflight": inflight, "queued": queued,
                 "latency_ewma_ms": lat_ms}
 
     def shutdown(self) -> None:
         import ray_tpu
-        for h in list(self._replicas):
+        for h in list(self._replicas) + list(self._loaners) + \
+                list(self._retiring):
             ray_tpu.kill(h)
         self._replicas.clear()
+        self._loaners.clear()
+        self._retiring.clear()
+        # the deployment's KV counters (inflight/queued/lat/batch*) are
+        # keyed by a per-controller random base: delete them, or every
+        # run/delete cycle leaks namespace entries forever
+        from ray_tpu.experimental.internal_kv import (_internal_kv_del,
+                                                      _internal_kv_list)
+        try:
+            suffix = self._kv_base.encode()
+            for key in _internal_kv_list(b"", namespace="serve"):
+                if key.endswith(suffix):
+                    _internal_kv_del(key, namespace="serve")
+        except Exception:   # noqa: BLE001 — cleanup is best-effort
+            pass
 
 
 # -- handle ------------------------------------------------------------------
@@ -369,17 +447,19 @@ class DeploymentHandle:
 
     def __init__(self, controller_handle, method: str = "__call__",
                  stream: bool = False, multiplexed_model_id: str = "",
-                 timeout_s: float | None = None):
+                 timeout_s: float | None = None, session_id: str = ""):
         self._controller = controller_handle
         self._method = method
         self._stream = stream
         self._mux_id = multiplexed_model_id
         self._timeout_s = timeout_s
+        self._session_id = session_id
 
     def options(self, *, method_name: str | None = None,
                 stream: bool | None = None,
                 multiplexed_model_id: str | None = None,
-                timeout_s: float | None = None) -> "DeploymentHandle":
+                timeout_s: float | None = None,
+                session_id: str | None = None) -> "DeploymentHandle":
         """``stream=True``: calls return an ObjectRefGenerator — the
         replica method must be a generator; items stream back with
         backpressure (reference: handle.options(stream=True)).
@@ -388,25 +468,28 @@ class DeploymentHandle:
         LRU cache stays hot.  ``timeout_s``: per-request deadline —
         a request still queued in the router when it expires is
         DROPPED before dispatch and its ref raises
-        ``GetTimeoutError``."""
+        ``GetTimeoutError``.  ``session_id``: consistent-hash the call
+        onto one router shard (the per-ingress sharded request plane —
+        a multiplexed model id implies its own session key)."""
         return DeploymentHandle(
             self._controller,
             method_name if method_name is not None else self._method,
             stream if stream is not None else self._stream,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._mux_id,
-            timeout_s if timeout_s is not None else self._timeout_s)
+            timeout_s if timeout_s is not None else self._timeout_s,
+            session_id if session_id is not None else self._session_id)
 
     def remote(self, *args, **kwargs):
-        from .router import RequestRouter
-        return RequestRouter.for_controller(self._controller).submit(
+        from .router import RouterGroup
+        return RouterGroup.for_controller(self._controller).submit(
             self._method, args, kwargs, self._mux_id, self._stream,
-            self._timeout_s)
+            self._timeout_s, session=self._session_id)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._controller, self._method, self._stream,
-                 self._mux_id, self._timeout_s))
+                 self._mux_id, self._timeout_s, self._session_id))
 
 
 # -- deployment / application ------------------------------------------------

@@ -140,6 +140,21 @@ class HttpIngress(BackgroundHTTPServer):
             if timeout <= 0:
                 self._reply_deadline(request, "deadline already expired")
                 return
+        # sharded request plane: a session key (X-Session-Id header,
+        # else the multiplexed model id header) consistent-hashes the
+        # call onto one router shard — the in-process analogue of each
+        # ingress replica owning a shard.  Sessionless requests spread
+        # round-robin across shards.
+        session = (request.headers.get("X-Session-Id")
+                   or request.headers.get("serve_multiplexed_model_id")
+                   or "")
+        mux = request.headers.get("serve_multiplexed_model_id") or ""
+        if session or mux:
+            handle = handle.options(session_id=session,
+                                    multiplexed_model_id=mux)
+            if stream_handle is not None:
+                stream_handle = stream_handle.options(
+                    session_id=session, multiplexed_model_id=mux)
         if stream_handle is not None:
             try:
                 gen = stream_handle.remote(req)

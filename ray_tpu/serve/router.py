@@ -10,10 +10,17 @@ instead of over-submitting), bounds that queue at
 and picks replicas with power-of-two-choices on observed load
 (SURVEY.md §1 layer 14; mount empty).
 
-Here the ``RequestRouter`` is process-global per controller (every
-handle facade for one deployment shares one router, so the load view
-and the queue are coherent), and queued requests are PROMISE object
-refs: ``remote()`` never blocks — when all replicas are saturated it
+Here the request plane is SHARDED (the per-ingress router model): a
+``RouterGroup`` per controller owns ``serve_router_shards``
+``RequestRouter`` shards, sessions (multiplexed model ids, HTTP
+``X-Session-Id``) consistent-hash onto shards, and each shard routes
+power-of-two-choices on its own exact counts plus the peer shards'
+gossiped load digests (``serve/gossip.py`` — folded at most every
+``serve_gossip_interval_s``, piggybacked on the health probe round).
+Replica stickiness itself is rendezvous hashing over *replica* ids, so
+it is shard-independent by construction: re-sharding, shard restarts,
+and refreshes cannot move a model's traffic.  Queued requests are
+PROMISE object refs: ``remote()`` never blocks — when all replicas are saturated it
 allocates a fresh object id, parks the request in the bounded queue,
 and returns a ref to the not-yet-submitted result.  A dispatcher
 thread submits parked requests as completions free replica slots,
@@ -41,6 +48,7 @@ itself fails.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -131,35 +139,27 @@ class _Queued:
 # -- router ------------------------------------------------------------------
 
 class RequestRouter:
-    """One per deployment per process; see module docstring."""
-
-    _registry: dict[bytes, "RequestRouter"] = {}
-    _reg_lock = threading.Lock()
+    """One router SHARD (see module docstring).  ``for_controller`` /
+    ``discard`` remain as compatibility entry points but resolve
+    through the :class:`RouterGroup` registry — the group is the
+    per-deployment object now."""
 
     @classmethod
-    def for_controller(cls, controller) -> "RequestRouter":
-        key = controller._actor_id.binary()
-        with cls._reg_lock:
-            router = cls._registry.get(key)
-            if router is None:
-                router = cls._registry[key] = cls(controller)
-            return router
+    def for_controller(cls, controller) -> "RouterGroup":
+        return RouterGroup.for_controller(controller)
 
     @classmethod
     def discard(cls, controller) -> None:
-        key = controller._actor_id.binary()
-        with cls._reg_lock:
-            router = cls._registry.pop(key, None)
-        if router is not None:
-            router._close()
+        RouterGroup.discard(controller)
 
     @classmethod
     def _routers(cls) -> list["RequestRouter"]:
-        with cls._reg_lock:
-            return list(cls._registry.values())
+        return [s for g in RouterGroup._groups() for s in g._shards]
 
-    def __init__(self, controller):
+    def __init__(self, controller, shard_id: int = 0, group=None):
         self._controller = controller
+        self._shard_id = shard_id
+        self._group = group
         self._cv = threading.Condition()
         self._version = -1
         self._replicas: list = []
@@ -247,7 +247,17 @@ class RequestRouter:
 
     # -- replica choice ------------------------------------------------------
     def _load_locked(self, replica) -> int:
-        return self._inflight.get(replica._actor_id.binary(), 0)
+        """Routing load for one replica: this shard's exact live count
+        plus the peer shards' gossiped (bounded-stale) contribution.
+        With one shard the remote term is identically zero and this is
+        the PR-3 single-router behavior, bit for bit."""
+        key = replica._actor_id.binary()
+        own = self._inflight.get(key, 0)
+        if self._group is None or self._group.num_shards == 1:
+            return own
+        from .gossip import board
+        return own + board.remote_load(self._kv_base, self._shard_id,
+                                       key)
 
     def _refresh_suspects_locked(self) -> set[bytes]:
         """Actor-id binaries of replicas on SUSPECT nodes (gray
@@ -330,6 +340,8 @@ class RequestRouter:
     def submit(self, method: str, args: tuple, kwargs: dict, mux: str,
                stream: bool, timeout_s: float | None):
         self._ensure_view()
+        if self._group is not None:
+            self._group.maybe_fold()    # gossip: refresh stale digests
         self._controller.tick.remote()  # fire-and-forget scale poke
         if stream:
             return self._submit_stream(method, args, kwargs, mux)
@@ -359,6 +371,10 @@ class RequestRouter:
         from ray_tpu.common.ids import ObjectID
         from ray_tpu.runtime.object_ref import ObjectRef
         limit = self._cfg.get("max_queued", 200)
+        if self._group is not None and self._group.num_shards > 1:
+            # the deployment-level queue bound splits across shards, so
+            # total parked work stays ~max_queued regardless of shards
+            limit = max(1, limit // self._group.num_shards)
         if len(self._queue) >= limit:
             with self._stats.lock:
                 self._stats.shed += 1
@@ -374,7 +390,8 @@ class RequestRouter:
         if self._dispatcher is None or not self._dispatcher.is_alive():
             self._dispatcher = threading.Thread(
                 target=self._dispatch_loop, daemon=True,
-                name=f"serve-router-{self._cfg.get('name', '?')}")
+                name=(f"serve-router-{self._cfg.get('name', '?')}"
+                      f"-s{self._shard_id}"))
             self._dispatcher.start()
         self._cv.notify_all()
         return ref
@@ -487,6 +504,8 @@ class RequestRouter:
     # -- queued dispatch -----------------------------------------------------
     def _dispatch_loop(self) -> None:
         while True:
+            if self._group is not None:
+                self._group.maybe_fold()
             expired: list[_Queued] = []
             to_send: list[tuple[_Queued, object]] = []
             with self._cv:
@@ -569,6 +588,7 @@ class RequestRouter:
         with self._cv:
             out = {
                 "deployment": self._cfg.get("name", ""),
+                "shard": self._shard_id,
                 "replicas": len(self._replicas),
                 "queued": len(self._queue),
                 "inflight": sum(self._inflight.values()),
@@ -577,6 +597,213 @@ class RequestRouter:
             }
         out.update(self._stats.snapshot())
         out.update(batch_stats(self._kv_base))
+        return out
+
+
+# -- router group (the per-deployment object) --------------------------------
+
+class RouterGroup:
+    """All router shards for one deployment.  Sessions consistent-hash
+    onto shards (rendezvous over shard ids — stable across shard
+    restarts); sessionless traffic round-robins.  The group is also the
+    gossip publisher: ``fold()`` snapshots every shard's digest and
+    merges it onto the process :data:`~ray_tpu.serve.gossip.board`."""
+
+    _registry: dict[bytes, "RouterGroup"] = {}
+    _reg_lock = threading.Lock()
+
+    @classmethod
+    def for_controller(cls, controller,
+                       num_shards: int | None = None) -> "RouterGroup":
+        key = controller._actor_id.binary()
+        with cls._reg_lock:
+            group = cls._registry.get(key)
+            if group is None:
+                group = cls._registry[key] = cls(controller, num_shards)
+            return group
+
+    @classmethod
+    def discard(cls, controller) -> None:
+        key = controller._actor_id.binary()
+        with cls._reg_lock:
+            group = cls._registry.pop(key, None)
+        if group is not None:
+            group._close()
+
+    @classmethod
+    def _groups(cls) -> list["RouterGroup"]:
+        with cls._reg_lock:
+            return list(cls._registry.values())
+
+    def __init__(self, controller, num_shards: int | None = None):
+        if num_shards is None:
+            from ray_tpu.common.config import get_config
+            num_shards = get_config().serve_router_shards
+        self.num_shards = max(1, int(num_shards))
+        self._controller = controller
+        self._shards = [RequestRouter(controller, shard_id=i, group=self)
+                        for i in range(self.num_shards)]
+        self._rr = itertools.count()
+        self._fold_lock = threading.Lock()
+        self._folded_at = 0.0
+
+    # -- shard choice --------------------------------------------------------
+    def shard_for(self, session: str | None) -> RequestRouter:
+        """Consistent-hash session stickiness: the same session key
+        always lands on the same shard (warm queue position, coherent
+        per-session ordering); shard ids are stable, so the mapping
+        survives a shard restart.  Sessionless calls round-robin."""
+        if self.num_shards == 1:
+            return self._shards[0]
+        if session:
+            import hashlib
+            i = max(range(self.num_shards),
+                    key=lambda k: hashlib.md5(
+                        b"%d|" % k + session.encode()).digest())
+            return self._shards[i]
+        return self._shards[next(self._rr) % self.num_shards]
+
+    def submit(self, method: str, args: tuple, kwargs: dict, mux: str,
+               stream: bool, timeout_s: float | None,
+               session: str | None = None):
+        return self.shard_for(session or mux).submit(
+            method, args, kwargs, mux, stream, timeout_s)
+
+    # -- gossip --------------------------------------------------------------
+    def fold(self) -> None:
+        """Snapshot every shard's digest (each under its own lock, none
+        held while publishing) and merge onto the board, evicting
+        replicas that left the controller's membership."""
+        from .gossip import board
+        digests: dict[int, dict[bytes, int]] = {}
+        live: set[bytes] = set()
+        base = ""
+        for s in self._shards:
+            with s._cv:
+                digests[s._shard_id] = dict(s._inflight)
+                live.update(r._actor_id.binary() for r in s._replicas)
+                base = base or s._kv_base
+        if base:
+            board.fold(base, digests, live)
+            self._folded_at = _now()
+
+    def maybe_fold(self) -> None:
+        """Fold when the board view is older than the gossip interval —
+        the opportunistic half of the protocol (the periodic half rides
+        the health probe round)."""
+        if self.num_shards == 1:
+            return
+        from ray_tpu.common.config import get_config
+        interval = get_config().serve_gossip_interval_s
+        if _now() - self._folded_at < interval:
+            return
+        if self._fold_lock.acquire(blocking=False):
+            try:
+                if _now() - self._folded_at >= interval:
+                    self.fold()
+            finally:
+                self._fold_lock.release()
+
+    # -- compat with the single-router surface -------------------------------
+    def _refresh(self, force: bool = False) -> None:
+        for s in self._shards:
+            s._refresh(force=force)
+
+    def restart_shard(self, i: int) -> RequestRouter:
+        """Replace one shard in place (crash-and-recreate model).  The
+        new shard re-fetches the replica view; session->shard and
+        mux->replica hashes are both id-stable, so stickiness holds."""
+        old = self._shards[i]
+        self._shards[i] = RequestRouter(self._controller, shard_id=i,
+                                        group=self)
+        old._close()
+        return self._shards[i]
+
+    def _close(self) -> None:
+        from .gossip import board
+        base = ""
+        for s in self._shards:
+            base = base or s._kv_base
+            s._close()
+        if base:
+            board.evict(base)
+
+    # -- merged stats --------------------------------------------------------
+    def backlog(self) -> tuple[int, int, float]:
+        """(queued, inflight, latency_ewma_ms) across shards — the
+        driver-side load signal the capacity-loan manager reads."""
+        queued = inflight = 0
+        ewma = 0.0
+        for s in self._shards:
+            with s._cv:
+                queued += len(s._queue)
+                inflight += sum(s._inflight.values())
+            ewma = max(ewma, s._stats.ewma_ms)
+        return queued, inflight, ewma
+
+    def cfg(self) -> dict:
+        for s in self._shards:
+            if s._cfg:
+                return s._cfg
+        return {}
+
+    def snapshot(self) -> dict:
+        """The deployment-level view: counters summed across shards,
+        latency percentiles over the merged sample window, batch stats
+        read once (they are deployment-level KV counters)."""
+        from .gossip import board
+        base = ""
+        replicas = queued = inflight = 0
+        completed = user_errors = transport_errors = shed = expired = 0
+        lats: list[float] = []
+        recent = 0
+        ewma = 0.0
+        cfg: dict = {}
+        now = time.monotonic()
+        for s in self._shards:
+            with s._cv:
+                replicas = max(replicas, len(s._replicas))
+                queued += len(s._queue)
+                inflight += sum(s._inflight.values())
+                base = base or s._kv_base
+                cfg = cfg or s._cfg
+            st = s._stats
+            with st.lock:
+                completed += st.completed
+                user_errors += st.user_errors
+                transport_errors += st.transport_errors
+                shed += st.shed
+                expired += st.expired
+                lats.extend(st._lat_ms)
+                recent += sum(1 for t in st._done_t
+                              if now - t <= _QPS_WINDOW_S)
+                if st.completed:
+                    ewma = max(ewma, st.ewma_ms)
+        lats.sort()
+        out = {
+            "deployment": cfg.get("name", ""),
+            "replicas": replicas,
+            "queued": queued,
+            "inflight": inflight,
+            "max_ongoing_requests": cfg.get("max_ongoing", 0),
+            "max_queued_requests": cfg.get("max_queued", 0),
+            "shards": self.num_shards,
+            "completed": completed,
+            "user_errors": user_errors,
+            "transport_errors": transport_errors,
+            "shed": shed,
+            "expired": expired,
+            "qps": round(recent / _QPS_WINDOW_S, 2),
+            "latency_ewma_ms": round(ewma, 3),
+            "gossip_digest": board.digest_size(base),
+        }
+        if lats:
+            out["p50_ms"] = round(lats[len(lats) // 2], 3)
+            out["p99_ms"] = round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))], 3)
+        else:
+            out["p50_ms"] = out["p99_ms"] = 0.0
+        out.update(batch_stats(base))
         return out
 
 
@@ -607,16 +834,20 @@ def batch_stats(kv_base: str) -> dict:
 
 
 def request_plane_stats() -> dict[str, dict]:
-    """Per-deployment request-plane stats for every router in this
-    process, keyed by deployment name (metrics/dashboard/status hook)."""
+    """Per-deployment request-plane stats for every router group in
+    this process, keyed by deployment name (metrics/dashboard/status
+    hook).  Counters are merged across the group's shards."""
     out: dict[str, dict] = {}
-    for router in RequestRouter._routers():
+    for group in RouterGroup._groups():
         try:
-            snap = router.snapshot()
+            snap = group.snapshot()
         except Exception:   # noqa: BLE001
             continue
         name = snap.get("deployment") or "?"
         if name in out:
-            name = f"{name}@{router._kv_base[:4]}"
+            base = ""
+            for s in group._shards:
+                base = base or s._kv_base
+            name = f"{name}@{base[:4]}"
         out[name] = snap
     return out

@@ -30,7 +30,8 @@ __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
            "build_schedule"]
 
 CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
-             "drain_churn", "autoscaler_flap", "broadcast_storm")
+             "drain_churn", "autoscaler_flap", "broadcast_storm",
+             "serve_diurnal")
 
 _SETTLE_CAP_S = 900.0       # virtual budget for the quiesce phase
 
@@ -115,6 +116,11 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
         # gray links: the broadcast plane's re-parenting under fire
         "broadcast_storm": (("broadcast", 0.45), ("kill_node", 0.3),
                             ("gray_slow", 0.15), ("kill_head", 0.1)),
+        # diurnal serve load driving loan->serve->reclaim while kills
+        # land on replicas and LOANED rows: the capacity-loan state
+        # machine and request re-dispatch under fire
+        "serve_diurnal": (("kill_node", 0.5), ("gray_slow", 0.2),
+                          ("drain", 0.2), ("kill_head", 0.1)),
     }
     ops, weights = zip(*mixes[campaign])
     sched = []
@@ -176,6 +182,7 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                  faults: int = 50, duration: float | None = None,
                  params: SimParams | None = None,
                  autoscale: bool = True, lock_order: bool = False,
+                 serve: dict | None = None,
                  out: str | None = None, progress=None) -> CampaignResult:
     """Execute one campaign; returns a :class:`CampaignResult` whose
     ``trace_hash`` is the replay fingerprint."""
@@ -193,6 +200,12 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                                  duration)
 
     cluster = SimCluster(num_nodes, seed=seed, params=params)
+    plane = None
+    if campaign == "serve_diurnal":
+        from .serve import SimServePlane
+        plane = SimServePlane(cluster, seed=seed, duration=duration,
+                              **(serve or {}))
+        cluster.serve_plane = plane
     if lock_order:
         from ..common import lockorder
         if not lockorder.installed():
@@ -242,6 +255,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
             if hit:
                 for w in waves:
                     w.on_node_killed(kw["node"])
+                if plane is not None:
+                    plane.on_node_killed(kw["node"])
             trace.rec(t, "fault", op=op, node=kw["node"], hit=hit)
         elif op == "broadcast":
             from .broadcast import SimBroadcastWave
@@ -282,6 +297,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                 cluster.enable_autoscaler(
                     min_nodes=num_nodes,
                     max_nodes=num_nodes + max(8, num_nodes // 10))
+            if plane is not None:
+                plane.start()
             for t, jid, tasks in jobs:
                 clock.call_later(
                     t, lambda jid=jid, tasks=tasks: submit(jid, tasks))
@@ -310,7 +327,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                            "succeeded")
                 completed_cache["n"] = done
                 return done == len(acked) and \
-                    all(w.terminal for w in waves)
+                    all(w.terminal for w in waves) and \
+                    (plane is None or plane.terminal)
 
             settle_end = duration + _SETTLE_CAP_S
             while not all_done() and clock.monotonic() < settle_end:
@@ -352,6 +370,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         violations=violations, trace_hash=trace.hash(),
         virtual_s=clock.monotonic(), wall_s=wall,
         stats=cluster.stats())
+    if plane is not None:
+        result.stats["serve"] = plane.stats()
     if out:
         write_artifact(out, result, trace, duration, faults)
     return result

@@ -298,6 +298,12 @@ class SimHead:
         if row is None or row["state"] in (DEAD, REMOVED):
             return "reregister"
         row["last_hb"] = self.clock.monotonic()
+        # serve-plane piggyback: the load digest for this node's replica
+        # folds on the heartbeat that carries its liveness — the same
+        # no-extra-RPC contract as the live gossip board
+        plane = self.cluster.serve_plane
+        if plane is not None:
+            plane.on_heartbeat(nid)
         return "ok"
 
     def _h_job_submit(self, jid: str, tasks: dict) -> str:
@@ -406,6 +412,7 @@ class SimHead:
 
     # -- scheduling ----------------------------------------------------------
     def _pick_node(self) -> str | None:
+        plane = self.cluster.serve_plane
         for allow_suspect in (False, True):     # soft-avoid: two passes
             n = len(self._node_order)
             for off in range(n):
@@ -413,6 +420,8 @@ class SimHead:
                 row = self.nodes.get(nid)
                 if row is None or row["state"] != ALIVE:
                     continue
+                if plane is not None and nid in plane.reserved:
+                    continue    # serve replica or LOANED: off the market
                 if row["suspect"] and not allow_suspect:
                     continue
                 if len(row["running"]) >= self.params.node_capacity:
@@ -597,12 +606,15 @@ class SimAutoscaler:
         if head is not None and head.alive:
             p = cl.params
             now = cl.clock.monotonic()
+            plane = cl.serve_plane
             alive = []
             free = 0
             for nid in head._node_order:
                 row = head.nodes.get(nid)
                 if row is not None and row["state"] == ALIVE:
                     alive.append(nid)
+                    if plane is not None and nid in plane.reserved:
+                        continue    # serve/LOANED rows add no batch slack
                     if not row["suspect"]:
                         free += p.node_capacity - len(row["running"])
             pending = len(head.pending)
@@ -624,6 +636,8 @@ class SimAutoscaler:
                 for nid in alive:
                     if drained >= min(2, surplus):  # gentle: <=2/tick
                         break
+                    if plane is not None and nid in plane.reserved:
+                        continue    # never idle-drain a serve replica
                     row = head.nodes[nid]
                     if not row["running"] and \
                             now - row["idle_since"] > \
@@ -657,6 +671,7 @@ class SimCluster:
         self.running = True
         self.head: SimHead | None = None
         self.autoscaler: SimAutoscaler | None = None
+        self.serve_plane = None     # installed by serve_diurnal campaigns
         self.start_head()
         period = self.params.heartbeat_period_s
         for i in range(num_nodes):

@@ -18,6 +18,10 @@ The five invariants, and the machinery each one proves:
 5. **lock-order digraph stays acyclic** — the runtime lock-order
    recorder (``common/lockorder.py``), when installed, over the real
    locks the simulation exercises (chaos links, breakers)
+6. **serve plane conserves requests and reclaims loans** — when a
+   ``serve_diurnal`` campaign installed a ``SimServePlane``: every
+   accepted request is accounted for in some queue (strictly:
+   completed), and capacity loans converge to reclaimed-or-booked-lost
 """
 
 from __future__ import annotations
@@ -95,6 +99,17 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
                     violations.append(
                         f"acked job incomplete after quiesce: {jid} "
                         f"({n_done}/{len(job['tasks'])} tasks done)")
+
+    # 6. serve plane (when a serve_diurnal campaign installed one):
+    # accepted requests are conserved — counter matches the structural
+    # sum of every queue — and loan drains converge; strictly, every
+    # accepted request completed and every loan was reclaimed or its
+    # loss booked
+    plane = getattr(cluster, "serve_plane", None)
+    if plane is not None and plane.started:
+        v, n = plane.check(strict=strict, now=now, grace=grace)
+        violations.extend(v)
+        checks += n
 
     # 5. runtime lock-order digraph stays acyclic (when the recorder
     # is armed — see rtlint_runtime_lock_order)

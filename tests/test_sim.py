@@ -74,6 +74,23 @@ def test_different_seeds_diverge():
     assert r1.trace_hash != r2.trace_hash
 
 
+def test_serve_diurnal_campaign_replays_bit_for_bit():
+    """The serve plane (sharded routing, gossip folds, loan cycles,
+    diurnal arrivals) runs on the same Philox stream discipline as the
+    rest of the simulator: same seed, same trace hash."""
+    kw = dict(seed=7, campaign="serve_diurnal", faults=10,
+              duration=200.0)
+    r1 = run_campaign(64, **kw)
+    r2 = run_campaign(64, **kw)
+    assert r1.ok, r1.violations
+    assert r1.trace_hash == r2.trace_hash
+    s = r1.stats["serve"]
+    assert s["accepted"] > 0
+    # zero accepted-request loss: every admitted request completed
+    assert s["accepted"] == s["completed"] and s["outstanding"] == 0
+    assert s == r2.stats["serve"]
+
+
 @pytest.mark.parametrize("campaign", CAMPAIGNS)
 def test_every_campaign_archetype_green(campaign):
     r = run_campaign(48, seed=11, campaign=campaign, faults=8,

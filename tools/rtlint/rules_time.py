@@ -1,9 +1,11 @@
 """W5 seam discipline: control-plane code must not bypass the clock
 and transport seams.
 
-Two checks, scoped to ``ray_tpu/runtime/``, ``ray_tpu/rpc/`` and
-``ray_tpu/broadcast/`` (the code the in-process simulator runs under a
-virtual clock):
+Two checks, scoped to ``ray_tpu/runtime/``, ``ray_tpu/rpc/``,
+``ray_tpu/broadcast/`` and the serve-plane control modules
+``ray_tpu/serve/gossip.py`` / ``ray_tpu/serve/loaning.py`` (the code
+the in-process simulator runs under a virtual clock — loan reclaim
+deadlines and gossip staleness both ride the clock seam):
 
 - **clock bypass**: a direct call to ``time.time()``,
   ``time.monotonic()`` or ``time.sleep()`` — including through an
@@ -22,7 +24,7 @@ virtual clock):
   to real sockets and cuts it out of the simulator.  The ``rpc/``
   package itself is exempt — it *implements* the transport.
 
-``common/clock.py`` (the seam) and anything outside the two scoped
+``common/clock.py`` (the seam) and anything outside the scoped
 trees are never flagged.  Suppress a deliberate site with
 ``# rtlint: disable=W5`` (e.g. worker-subprocess code that genuinely
 wants wall time).
@@ -36,7 +38,8 @@ import re
 from .finding import Finding
 
 _CLOCK_FNS = ("time", "monotonic", "sleep")
-_SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/")
+_SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/",
+           "ray_tpu/serve/gossip.py", "ray_tpu/serve/loaning.py")
 _TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/")
 _EXEMPT = ("ray_tpu/common/clock.py", "ray_tpu/rpc/transport.py")
 
