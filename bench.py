@@ -143,17 +143,22 @@ def measure_plane_throughput(mb: int = 32) -> float:
 
 def delta_churn_bench(n_nodes: int = 256, n_classes: int = 32,
                       beats: int = 30, churn: int = 12,
-                      seed: int = 0) -> dict:
+                      seed: int = 0, shards: int = 1) -> dict:
     """Delta-scheduling heartbeat under node churn, on the REAL stack:
     a ClusterResourceManager takes random subtract/add_back mutations
     between beats and the DeltaScheduler syncs its HBM mirror from the
     dirty journal.  Returns hit rate, per-beat p50, the per-phase
     breakdown (profile mode inserts device syncs, so phase sums exceed
     the unprofiled beat wall time), and bit-parity of the final beat
-    vs the CPU oracle."""
+    vs the CPU oracle.
+
+    ``shards > 1`` runs the mesh-sharded engine instead (r14): node
+    rows partitioned over the device mesh with the two-level ICI/DCN
+    argmin reduce — same workload, same parity gate."""
     from ray_tpu.common.ids import NodeID
     from ray_tpu.common.resources import NodeResources, ResourceRequest
     from ray_tpu.scheduling import (ClusterResourceManager, DeltaScheduler,
+                                    ShardedDeltaScheduler,
                                     schedule_grouped_oracle)
 
     rng = np.random.default_rng(seed)
@@ -171,7 +176,8 @@ def delta_churn_bench(n_nodes: int = 256, n_classes: int = 32,
     densify_ms = (time.perf_counter() - t0) * 1e3
     counts = rng.integers(1, 40, size=n_classes).astype(np.int32)
 
-    eng = DeltaScheduler(crm)
+    eng = ShardedDeltaScheduler(crm, shards) if shards > 1 \
+        else DeltaScheduler(crm)
     eng.profile = True
     eng.phase_ms["densify"] += densify_ms
     churn_req = ResourceRequest({"CPU": 1})
@@ -199,10 +205,90 @@ def delta_churn_bench(n_nodes: int = 256, n_classes: int = 32,
         "phases_ms_per_beat": {k: round(v / n_beats, 4)
                                for k, v in eng.phase_ms.items()},
         "oracle_parity": bool((got == want).all()),
+        "shards": eng.stats.get("shards", 1),
         **{k: eng.stats[k] for k in ("beats", "delta_beats",
                                      "full_rescores", "clean_beats",
                                      "rows_uploaded")},
     }
+
+
+# sharded-phase names for the r14 breakdown (ISSUE 14 satellite 1):
+# the engine's phase timers keep the r08 keys; the record maps them to
+# what each phase IS on the sharded path.
+_SHARDED_PHASE_NAMES = {"h2d": "shard_upload", "score": "local_score",
+                        "argmin": "cross_device_reduce",
+                        "readback": "readback", "densify": "densify"}
+
+# per-device HBM budget for the ceiling model (v5e: 16 GiB/chip)
+_HBM_BYTES = 16 * (1 << 30)
+
+
+def _hbm_ceiling_classes(n_nodes: int, n_res: int, shards: int,
+                         budget: int = _HBM_BYTES) -> int:
+    """Largest resident class count whose scheduling plane fits ONE
+    device's HBM at S-way sharding, at ``n_nodes`` nodes (the contract
+    caps nodes at MAX_NODES, so classes are the unbounded axis of the
+    (tasks x nodes) problem).  Per device: its N/S key columns cost
+    4*N/S bytes per class plus the replicated (C, R) request row; the
+    node-state rows (totals/avail/masks) are class-independent.  Key
+    columns dominate, so max C scales ~linearly with S."""
+    rows = -(-n_nodes // shards)                # N/S, ceil
+    per_class = 4 * rows + 4 * n_res
+    fixed = rows * (8 * n_res + 2)
+    return max((budget - fixed) // per_class, 0)
+
+
+def sharded_delta_bench(n_nodes: int = 512, n_classes: int = 48,
+                        beats: int = 25, churn: int = 24,
+                        seed: int = 0, shards: int = 0) -> dict:
+    """The r14 sharded-vs-fused stage: the SAME churn workload through
+    the single-device engine and the mesh-sharded engine, with the
+    sharded per-phase breakdown (shard upload / local score /
+    cross-device reduce / readback) and the HBM-ceiling model showing
+    how much larger a problem the mesh holds than one chip.
+
+    Runs on whatever backend jax resolves — on the CPU fallback the
+    phase numbers are still real engine phases (8 virtual devices),
+    only the absolute times are not TPU times."""
+    import jax
+
+    from ray_tpu.ops.shard_reduce import resolve_shards
+    s = resolve_shards(shards, len(jax.local_devices()))
+    fused = delta_churn_bench(n_nodes, n_classes, beats, churn, seed,
+                              shards=1)
+    rec: dict = {"shards": s, "fused": fused}
+    if s > 1:
+        sharded = delta_churn_bench(n_nodes, n_classes, beats, churn,
+                                    seed, shards=s)
+        sharded["phases_ms_per_beat"] = {
+            _SHARDED_PHASE_NAMES.get(k, k): v
+            for k, v in sharded["phases_ms_per_beat"].items()}
+        rec["sharded"] = sharded
+        rec["bit_exact_fused_vs_sharded"] = bool(
+            sharded["oracle_parity"] and fused["oracle_parity"])
+    else:
+        rec["sharded"] = None
+        rec["note"] = "one device: single-chip fallback selected"
+    # ONE counts fetch per beat by construction, at any shard count:
+    # fused_beat gathers counts+argmin device-side and the host reads
+    # one (G, N+1) buffer (scheduling/policy.py beat()).
+    rec["readbacks_per_beat"] = 1
+    # HBM ceiling model at the contract's full node axis (MAX_NODES,
+    # 8 resource columns): how many resident scheduling classes — the
+    # unbounded axis of the (tasks x nodes) problem — the aggregate
+    # mesh holds vs one chip.
+    from ray_tpu.scheduling import MAX_NODES
+    kn, kr = MAX_NODES, 8
+    single = _hbm_ceiling_classes(kn, kr, 1)
+    sharded_c = _hbm_ceiling_classes(kn, kr, max(s, 1))
+    rec["hbm_ceiling_model"] = {
+        "nodes": kn, "resources": kr,
+        "hbm_bytes_per_device": _HBM_BYTES,
+        "max_classes_single_device": single,
+        "max_classes_sharded": sharded_c,
+        "problem_ratio": round(sharded_c / max(single, 1), 2),
+    }
+    return rec
 
 
 def _emit_smoke() -> None:
@@ -211,14 +297,19 @@ def _emit_smoke() -> None:
     records a real heartbeat number even with the tunnel down."""
     delta = delta_churn_bench(n_nodes=128, n_classes=16, beats=25,
                               churn=8)
+    sharded = sharded_delta_bench(n_nodes=128, n_classes=16, beats=12,
+                                  churn=8)
+    ok = delta["oracle_parity"] and \
+        sharded.get("bit_exact_fused_vs_sharded", True)
     print(json.dumps({
         "metric": "delta heartbeat smoke: CPU backend churn workload"
-                  + ("" if delta["oracle_parity"] else " [PARITY FAIL]"),
+                  + ("" if ok else " [PARITY FAIL]"),
         "value": delta["beat_p50_ms"],
         "unit": "ms",
         "vs_baseline": 0.0,         # smoke line: not the headline metric
         "status": "smoke",
         "delta": delta,
+        "sharded": sharded,
     }), flush=True)
 
 
@@ -310,7 +401,8 @@ def _cpu_fallback_p50(rounds: int = 5, reps: int = 3) -> float:
 
 
 def _emit_skipped(reason: str, cpu_p50: float | None = None,
-                  delta: dict | None = None) -> None:
+                  delta: dict | None = None,
+                  sharded: dict | None = None) -> None:
     """Graceful degradation for tunnel outages: one ``status:skipped``
     JSON line carrying the last-good device number (and the CPU
     fallback measurement when one ran) — instead of the old rc=3
@@ -331,6 +423,7 @@ def _emit_skipped(reason: str, cpu_p50: float | None = None,
         "cpu_fallback_p50_ms":
             round(cpu_p50, 3) if cpu_p50 is not None else None,
         "delta": delta,
+        "sharded": sharded,
     }), flush=True)
 
 
@@ -403,7 +496,14 @@ def main():
                 print(f"delta churn fallback failed: {e!r}",
                       file=sys.stderr)
                 delta = None
-            _emit_skipped(reason, cpu_p50, delta)
+            try:
+                sharded = sharded_delta_bench(n_nodes=256, n_classes=24,
+                                              beats=15, churn=16)
+            except Exception as e:   # noqa: BLE001 — record, don't die
+                print(f"sharded delta fallback failed: {e!r}",
+                      file=sys.stderr)
+                sharded = None
+            _emit_skipped(reason, cpu_p50, delta, sharded)
             return
         time.sleep(20.0)
 
@@ -496,6 +596,11 @@ def main():
         # under churn — phase breakdown + hit rate (module docstring)
         "delta": delta_churn_bench(n_nodes=N_NODES, n_classes=N_CLASSES,
                                    beats=30, churn=32),
+        # the r14 tentpole surface: sharded-vs-fused beat + the
+        # two-level reduce phase breakdown + the HBM-ceiling model
+        "sharded": sharded_delta_bench(n_nodes=N_NODES,
+                                       n_classes=N_CLASSES,
+                                       beats=20, churn=32),
     }))
 
 

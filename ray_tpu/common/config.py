@@ -90,6 +90,22 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "collectives over ICI.  Off (default) keeps single-device "
         "arrays — correct either way (dryrun-proven bit-equality); on "
         "one chip there is nothing to shard."),
+    "scheduler_shards": (
+        int, 1,
+        "Node-shard count for the mesh-sharded delta heartbeat "
+        "(ShardedDeltaScheduler): each of S devices holds N/S node rows "
+        "of the CRM mirror + key tensor and uploads only its shard's "
+        "dirty rows per beat.  1 (default) keeps the single-device "
+        "DeltaScheduler; 0 = one shard per local device; values are "
+        "clamped to the local device count and rounded DOWN to a power "
+        "of two so shards divide the pow2-bucketed node axis evenly."),
+    "scheduler_shard_reduce": (
+        str, "auto",
+        "Mesh topology for the sharded heartbeat's cross-device "
+        "reductions: 'flat' = one (1, S) all-ICI axis; 'two_level' = "
+        "(2, S/2) slices so psum/pmin lower to ICI within a slice then "
+        "DCN across; 'auto' (default) derives slice grouping from the "
+        "devices' slice_index when present, else flat."),
     # -- object store -------------------------------------------------------
     "object_store_memory_mb": (
         int, 512,

@@ -730,11 +730,17 @@ class Raylet:
         overrides and suspect rows as a per-beat soft mask — both with
         the exact ``_effective_snapshot`` arithmetic, so placements are
         bit-identical to the snapshot path.  One counts readback per
-        beat.  Returns (G, N+1) int32 counts."""
-        from ..scheduling.policy import DeltaScheduler
+        beat.  Returns (G, N+1) int32 counts.
+
+        The engine comes from ``make_delta_scheduler``: with
+        ``scheduler_shards`` resolving past one chip the mirror and the
+        beat shard over the device mesh (ShardedDeltaScheduler), else
+        the single-device DeltaScheduler — placements are bit-identical
+        either way."""
+        from ..scheduling.sharded_delta import make_delta_scheduler
         eng = self._delta_engine
         if eng is None:
-            eng = self._delta_engine = DeltaScheduler(self.crm)
+            eng = self._delta_engine = make_delta_scheduler(self.crm)
         _v, totals_f, avail_f, place_mask, _rows = self.crm.delta_view(-2)
         # suspect soft-avoid, same healthy-survivor rule as
         # _effective_snapshot (suspect is advisory, never hard)

@@ -18,6 +18,7 @@ reference's local-node-first traversal when the local node registers first.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from collections import deque
@@ -82,8 +83,13 @@ class ClusterResourceManager:
         self._log_floor = 0
         self._struct_version = 0  # last capacity/width growth epoch
         # epoch-memoized read-only copies handed out by snapshot()/arrays()/
-        # delta_view(): (version, totals, avail, raw_mask, place_mask)
+        # delta_view(): (version, totals, avail, raw_mask, place_mask).
+        # Two generations rotate so a stale epoch can usually be brought
+        # current by patching only the rows dirtied since it was built
+        # (see _frozen_locked) instead of re-copying every shard's rows.
         self._frozen: tuple | None = None
+        self._frozen_prev: tuple | None = None
+        self.frozen_stats = {"full": 0, "patched": 0, "rows_patched": 0}
         # interned dense request vectors: (req.key(), width) -> frozen vec
         self._req_cache: dict[tuple, np.ndarray] = {}
 
@@ -360,21 +366,71 @@ class ClusterResourceManager:
             self._mark(row)
 
     # -- views --------------------------------------------------------------
+    # a frozen array nobody else holds has exactly this many refs at the
+    # getrefcount call: the generation tuple + getrefcount's argument
+    _FROZEN_FREE_REFS = 2
+
+    def _recycle_frozen_locked(self) -> tuple | None:
+        """Bring the RETIRED frozen generation current by patching only
+        the rows dirtied since it was built, instead of re-copying every
+        node shard's rows because one row moved.  Returns the patched
+        generation, or None when only a full rebuild is sound:
+
+        - no retired generation yet, or shapes grew under it
+          (_struct_version), or the dirty journal was truncated past it
+          (_log_floor) so "which rows?" cannot be answered;
+        - some consumer still holds one of its arrays (refcount probe) —
+          patching in place would mutate a view handed out as immutable.
+
+        Caller holds _lock (getrefcount is exact under the GIL)."""
+        cand = self._frozen_prev
+        if cand is None:
+            return None
+        v0 = cand[0]
+        if v0 < self._struct_version or v0 < self._log_floor or \
+                cand[1].shape != self.totals.shape:
+            return None
+        for i in range(1, 5):
+            if sys.getrefcount(cand[i]) > self._FROZEN_FREE_REFS:
+                return None
+        rows = sorted({r for (ver, r) in self._dirty_log if ver > v0})
+        _v, totals, avail, raw_mask, place_mask = cand
+        for arr in (totals, avail, raw_mask, place_mask):
+            arr.setflags(write=True)
+        if rows:
+            totals[rows] = self.totals[rows]
+            avail[rows] = self.avail[rows]
+            raw_mask[rows] = self.node_mask[rows]
+            place_mask[rows] = self.node_mask[rows] & \
+                ~self.draining[rows]
+        for arr in (totals, avail, raw_mask, place_mask):
+            arr.setflags(write=False)
+        self.frozen_stats["patched"] += 1
+        self.frozen_stats["rows_patched"] += len(rows)
+        return (self.version, totals, avail, raw_mask, place_mask)
+
     def _frozen_locked(self) -> tuple:
         """Epoch-memoized read-only copies of the state arrays.  One set
         of copies per epoch, shared by snapshot()/arrays()/delta_view():
-        unchanged beats stop re-copying three arrays per heartbeat.
+        unchanged beats stop re-copying three arrays per heartbeat, and
+        dirty beats recycle the retired generation row-by-row
+        (_recycle_frozen_locked) rather than rebuilding every view.
         Caller holds _lock."""
-        if self._frozen is None or self._frozen[0] != self.version:
+        if self._frozen is not None and self._frozen[0] == self.version:
+            return self._frozen
+        gen = self._recycle_frozen_locked()
+        if gen is None:
             totals = self.totals.copy()
             avail = self.avail.copy()
             raw_mask = self.node_mask.copy()
             place_mask = self.node_mask & ~self.draining
             for arr in (totals, avail, raw_mask, place_mask):
                 arr.setflags(write=False)
-            self._frozen = (self.version, totals, avail, raw_mask,
-                            place_mask)
-        return self._frozen
+            gen = (self.version, totals, avail, raw_mask, place_mask)
+            self.frozen_stats["full"] += 1
+        self._frozen_prev = self._frozen
+        self._frozen = gen
+        return gen
 
     def snapshot(self) -> ClusterState:
         """Copy-on-read snapshot for a scheduling round (pure-function

@@ -301,10 +301,7 @@ class DeltaScheduler:
         bool (n,) soft mask ANDed into the placement mask for this beat
         (suspect avoidance) — the carried key tensor ignores it.
         """
-        import jax
-
         from ..common.config import get_config
-        from ..ops import hybrid_kernel as hk
 
         thr = int(threshold_fp(spread_threshold))
         v, totals, avail, place_mask, rows = \
@@ -354,15 +351,13 @@ class DeltaScheduler:
         else:
             emp = np.zeros((self._n,), bool)
             emp[:n_real] = np.asarray(extra_mask, bool)[:n_real]
-            em = jax.device_put(emp)
+            em = self._put_extra_mask(emp)
         if self.profile:
             self.phase_ms["densify"] += (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
 
-        counts_d, amin_d = hk.fused_beat(
-            self._totals, self._avail, self._mask, self._keys, self._reqs,
-            jax.device_put(slots_p), jax.device_put(counts_p), em,
-            ov[0], ov[1], thr, require_available=require_available)
+        counts_d, amin_d = self._fused_call(
+            slots_p, counts_p, em, ov, thr, require_available)
         self._last_amin = amin_d
         if self.profile:
             counts_d.block_until_ready()    # rtlint: disable=W6
@@ -408,13 +403,62 @@ class DeltaScheduler:
             np.asarray(req_vec, np.int32)).tobytes()
         return int(np.asarray(self._last_amin)[self._slot_of[key]])
 
+    # -- device-layout hooks (the mesh-sharded engine overrides these) ------
+    def _put_extra_mask(self, emp):
+        """Device placement of a padded per-beat soft mask."""
+        import jax
+        return jax.device_put(emp)
+
+    def _fused_call(self, slots_p, counts_p, em, ov, thr,
+                    require_available):
+        """The fused schedule->argmin device call; returns
+        (counts_device (G, n+1), amin_device (C,))."""
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        return hk.fused_beat(
+            self._totals, self._avail, self._mask, self._keys, self._reqs,
+            jax.device_put(slots_p), jax.device_put(counts_p), em,
+            ov[0], ov[1], thr, require_available=require_available)
+
+    def _put_state(self, ht, ha, hm):
+        """Place the padded mirror arrays (+ the resident all-true
+        mask); called by _full_sync after shape bookkeeping."""
+        import jax
+        self._totals = jax.device_put(ht)
+        self._avail = jax.device_put(ha)
+        self._mask = jax.device_put(hm)
+        self._ones = jax.device_put(np.ones(hm.shape, bool))
+
+    def _put_reqs(self, hr):
+        import jax
+        self._reqs = jax.device_put(hr)
+
+    def _full_rescore_call(self, thr):
+        from ..ops import hybrid_kernel as hk
+        return hk.full_rescore(self._totals, self._avail, self._mask,
+                               self._reqs, thr)
+
+    def _install_classes(self, idx, vecs, thr):
+        """Install freshly interned class rows (host idx/vec buffers)
+        into the resident request matrix + key tensor."""
+        import jax
+
+        from ..ops import hybrid_kernel as hk
+        self._reqs, self._keys = hk.apply_dirty_classes(
+            self._totals, self._avail, self._mask, self._keys,
+            self._reqs, jax.device_put(idx), jax.device_put(vecs), thr)
+
+    def _node_pad(self, n_real: int) -> int:
+        """Padded node-axis length (power-of-2 bucket, floor 64)."""
+        return _bucket(n_real, 64)
+
     # -- sync internals -----------------------------------------------------
     def _full_sync(self, totals, avail, mask, thr):
         import jax
 
-        from ..ops import hybrid_kernel as hk
         n_real, r_real = totals.shape
-        n = _bucket(n_real, 64)
+        n = self._node_pad(n_real)
         r = _bucket(r_real)
         if r_real != self._r_real and self._slot_of:
             # width grew: re-key the registry at the new width (dense
@@ -433,20 +477,16 @@ class DeltaScheduler:
         hm = np.zeros((n,), bool)
         hm[:n_real] = mask
         t0 = time.perf_counter() if self.profile else 0.0
-        self._totals = jax.device_put(ht)
-        self._avail = jax.device_put(ha)
-        self._mask = jax.device_put(hm)
-        self._ones = jax.device_put(np.ones((n,), bool))
-        self._empty_ov = None
         self._n, self._r = n, r
         self._n_real, self._r_real = n_real, r_real
+        self._put_state(ht, ha, hm)
+        self._empty_ov = None
         if self.profile:
             jax.block_until_ready(self._avail)  # rtlint: disable=W6
             self.phase_ms["h2d"] += (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
         self._rebuild_class_plane(thr, rescore=False)
-        self._keys = hk.full_rescore(self._totals, self._avail,
-                                     self._mask, self._reqs, thr)
+        self._keys = self._full_rescore_call(thr)
         if self.profile:
             jax.block_until_ready(self._keys)   # rtlint: disable=W6
             self.phase_ms["score"] += (time.perf_counter() - t0) * 1e3
@@ -483,23 +523,16 @@ class DeltaScheduler:
             self.phase_ms["score"] += (time.perf_counter() - t0) * 1e3
 
     def _rebuild_class_plane(self, thr, rescore=True):
-        import jax
-
-        from ..ops import hybrid_kernel as hk
         cap = _bucket(max(self._next_slot, 1))
         hr = np.zeros((cap, self._r), np.int32)
         for slot, vec in self._class_host.items():
             hr[slot, :vec.shape[0]] = vec
         self._cap_c = cap
-        self._reqs = jax.device_put(hr)
+        self._put_reqs(hr)
         if rescore:
-            self._keys = hk.full_rescore(self._totals, self._avail,
-                                         self._mask, self._reqs, thr)
+            self._keys = self._full_rescore_call(thr)
 
     def _ensure_classes(self, group_reqs, thr) -> np.ndarray:
-        import jax
-
-        from ..ops import hybrid_kernel as hk
         slots = np.empty((group_reqs.shape[0],), np.int32)
         fresh: list[tuple[int, np.ndarray]] = []
         for i, vec in enumerate(group_reqs):
@@ -525,10 +558,7 @@ class DeltaScheduler:
                 for j, (slot, vec) in enumerate(fresh):
                     idx[j] = slot
                     vecs[j, :vec.shape[0]] = vec
-                self._reqs, self._keys = hk.apply_dirty_classes(
-                    self._totals, self._avail, self._mask, self._keys,
-                    self._reqs, jax.device_put(idx), jax.device_put(vecs),
-                    thr)
+                self._install_classes(idx, vecs, thr)
         return slots
 
     def _pack_overrides(self, overrides):

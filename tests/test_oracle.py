@@ -422,3 +422,194 @@ class TestCrmEpochViews:
         assert a is b and not a.flags.writeable
         c = crm.intern_request(ResourceRequest({"CPU": 3}))
         assert c is not a
+
+
+class TestShardedDeltaSequenceOracle:
+    """Randomized delta-sequence parity for the mesh-sharded engine
+    (r14 tentpole gate): a ShardedDeltaScheduler at 2/4/8 shards fed
+    the SAME random CRM mutation stream stays bit-identical, every
+    beat, to the single-device DeltaScheduler and to the CPU grouped
+    oracle on a fresh snapshot.  conftest pins 8 virtual CPU devices,
+    so every shard count here runs in tier-1."""
+
+    @pytest.mark.parametrize("shards", [2, 4, 8])
+    def test_sharded_matches_single_device_and_oracle(self, shards):
+        from ray_tpu.scheduling import DeltaScheduler, ShardedDeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(seed=shards)
+        eng = ShardedDeltaScheduler(crm, shards)
+        ref = DeltaScheduler(crm)
+        assert eng.stats["shards"] == shards
+        debts = []
+        for _ in range(8):
+            _mutate(rng, crm, ids, debts)
+            got = eng.beat(vecs, counts)
+            np.testing.assert_array_equal(got, ref.beat(vecs, counts))
+            np.testing.assert_array_equal(
+                got, schedule_grouped_oracle(crm.snapshot(), vecs, counts))
+        assert eng.stats["delta_beats"] > 0
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_structural_growth_rebalances_shards(self, shards):
+        """Capacity growth moves the node axis under the shards: the
+        next beat re-pads, re-shards, and full-rescores — bit-exact
+        before AND after the re-balance."""
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources
+        from ray_tpu.scheduling import ShardedDeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(17, capacity=24)
+        eng = ShardedDeltaScheduler(crm, shards)
+        debts = []
+        for grow in (False, True, False, True, False):
+            if grow:                            # outgrow capacity=24
+                for _ in range(40):
+                    ids.append(crm.id_of(crm.add_node(
+                        NodeID.from_random(),
+                        NodeResources({"CPU": int(rng.integers(2, 32))}))))
+            _mutate(rng, crm, ids, debts)
+            np.testing.assert_array_equal(
+                eng.beat(vecs, counts),
+                schedule_grouped_oracle(crm.snapshot(), vecs, counts))
+        assert eng.stats["full_rescores"] >= 2
+
+    def test_shard_count_one_degenerate(self):
+        """shards=1 is a (1, 1) mesh — the sharded code path with no
+        partner to reduce with — and must stay bit-exact too."""
+        from ray_tpu.scheduling import ShardedDeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(19)
+        eng = ShardedDeltaScheduler(crm, 1)
+        assert eng.stats["shards"] == 1
+        debts = []
+        for _ in range(5):
+            _mutate(rng, crm, ids, debts)
+            np.testing.assert_array_equal(
+                eng.beat(vecs, counts),
+                schedule_grouped_oracle(crm.snapshot(), vecs, counts))
+
+    def test_factory_resolves_knobs(self):
+        """make_delta_scheduler: default knob (1 shard) falls back to
+        the single-device engine; 0 = one shard per local device;
+        non-power-of-two requests round down."""
+        from ray_tpu.scheduling import (DeltaScheduler,
+                                        ShardedDeltaScheduler,
+                                        make_delta_scheduler)
+
+        _rng, crm, _ids, _v, _c = _churn_cluster(23)
+        assert type(make_delta_scheduler(crm)) is DeltaScheduler
+        auto = make_delta_scheduler(crm, n_shards=0)
+        assert isinstance(auto, ShardedDeltaScheduler)
+        assert auto.stats["shards"] == 8        # conftest pins 8 devices
+        assert make_delta_scheduler(crm, n_shards=5).stats["shards"] == 4
+
+    def test_sharded_overrides_and_softmask(self):
+        """Planned-load overrides + the suspect soft mask land on the
+        right shards (global row -> owning device's local bucket)."""
+        from ray_tpu.scheduling import DeltaScheduler, ShardedDeltaScheduler
+
+        rng, crm, ids, vecs, counts = _churn_cluster(29)
+        eng = ShardedDeltaScheduler(crm, 4)
+        ref = DeltaScheduler(crm)
+        eng.beat(vecs, counts)
+        ref.beat(vecs, counts)
+        n_rows = crm.arrays()[0].shape[0]
+        over = {row: crm.arrays()[1][row] - np.int32(150)
+                for row in (0, 7, 15, 23)}
+        sus = np.ones(n_rows, bool)
+        sus[[1, 9]] = False
+        for ra in (False, True):
+            np.testing.assert_array_equal(
+                eng.beat(vecs, counts, overrides=over, extra_mask=sus,
+                         require_available=ra),
+                ref.beat(vecs, counts, overrides=over, extra_mask=sus,
+                         require_available=ra))
+
+
+class TestFrozenCacheRecycle:
+    """r14 satellite: the epoch-memoized frozen views recycle the
+    retired generation by patching only the dirtied rows instead of
+    re-copying every shard's rows on each resync — without ever
+    mutating a view some consumer still holds."""
+
+    def _crm(self, n=16):
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources
+        from ray_tpu.scheduling import ClusterResourceManager
+        crm = ClusterResourceManager(capacity=32)
+        rows = [crm.add_node(NodeID.from_random(),
+                             NodeResources({"CPU": 8}))
+                for _ in range(n)]
+        return crm, rows
+
+    def test_single_dirty_row_patches_not_rebuilds(self):
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        req = ResourceRequest({"CPU": 1})
+        crm.arrays()
+        crm.force_subtract(rows[0], req)
+        crm.arrays()                            # both generations exist
+        base = dict(crm.frozen_stats)
+        for i in range(6):
+            crm.force_subtract(rows[i % 16], req)
+            crm.arrays()
+        d = {k: crm.frozen_stats[k] - base[k] for k in base}
+        assert d["full"] == 0 and d["patched"] == 6
+        # each patch covers the rows dirtied across TWO epochs (the
+        # retired generation is two beats old), never the whole table
+        assert d["rows_patched"] <= 2 * d["patched"]
+
+    def test_patched_views_bit_exact_under_churn(self):
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        rng = np.random.default_rng(0)
+        req = ResourceRequest({"CPU": 1})
+        for _ in range(100):
+            row = rows[int(rng.integers(0, len(rows)))]
+            if rng.random() < 0.2:
+                crm.set_draining(crm.id_of(row), bool(rng.integers(0, 2)))
+            else:
+                crm.force_subtract(row, req)
+            _v, t, a, m, _rows = crm.delta_view(-2)
+            np.testing.assert_array_equal(t, crm.totals)
+            np.testing.assert_array_equal(a, crm.avail)
+            np.testing.assert_array_equal(
+                m, crm.node_mask & ~crm.draining)
+        assert crm.frozen_stats["patched"] > 50
+
+    def test_held_view_forces_full_copy(self):
+        """The immutability contract survives recycling: while any
+        consumer holds a frozen array, its generation is never patched
+        in place — a fresh copy is built instead."""
+        from ray_tpu.common.resources import ResourceRequest
+        crm, rows = self._crm()
+        req = ResourceRequest({"CPU": 1})
+        crm.arrays()
+        crm.force_subtract(rows[0], req)
+        held = crm.arrays()                     # hold gen 2's arrays
+        t_held = held[0].copy()
+        crm.force_subtract(rows[1], req)
+        crm.arrays()
+        crm.force_subtract(rows[2], req)
+        t_new = crm.arrays()[0]
+        assert t_new is not held[0]
+        assert not held[0].flags.writeable
+        np.testing.assert_array_equal(held[0], t_held)   # untouched
+        assert crm.frozen_stats["full"] >= 3
+
+    def test_struct_growth_falls_back_to_full_copy(self):
+        from ray_tpu.common.ids import NodeID
+        from ray_tpu.common.resources import NodeResources, ResourceRequest
+        crm, rows = self._crm()
+        req = ResourceRequest({"CPU": 1})
+        crm.arrays()
+        crm.force_subtract(rows[0], req)
+        crm.arrays()
+        before = crm.frozen_stats["full"]
+        for _ in range(20):                     # outgrow capacity=32
+            crm.add_node(NodeID.from_random(), NodeResources({"CPU": 4}))
+        crm.arrays()
+        crm.force_subtract(rows[1], req)
+        crm.arrays()                            # shapes moved: full again
+        assert crm.frozen_stats["full"] >= before + 1
+        np.testing.assert_array_equal(crm.arrays()[0], crm.totals)

@@ -803,10 +803,35 @@ class TestW6:
 
     def test_new_knobs_pass_w3(self):
         """The r08 knobs (scheduler_delta_beats,
-        scheduler_delta_max_dirty_fraction) are documented and
+        scheduler_delta_max_dirty_fraction) and the r14 knobs
+        (scheduler_shards, scheduler_shard_reduce) are documented and
         referenced — W3 stays clean on the live package."""
         new, _based, _stale, _ = analyzer.check(
             REPO_ROOT, "ray_tpu", rules=("W3",),
             baseline_path=os.path.join(REPO_ROOT, "tools", "rtlint",
                                        "baseline.json"))
         assert new == [], [f.format_text() for f in new]
+
+    def test_sharded_beat_modules_in_scope_with_zero_baseline(self):
+        """The r14 shard-reduce plane is inside W6's scope (its paths
+        match the ops//scheduling/ prefixes) AND contributes zero
+        baseline entries: every sanctioned sync in the new modules is
+        inline-annotated, none is grandfathered."""
+        from tools.rtlint import rules_device
+        new_modules = ("ray_tpu/ops/shard_reduce.py",
+                       "ray_tpu/scheduling/sharded_delta.py")
+        for mod in new_modules:
+            assert os.path.exists(os.path.join(REPO_ROOT, mod))
+            assert any(mod.startswith(sc) for sc in rules_device._SCOPES)
+        accepted = baseline_mod.load(os.path.join(
+            REPO_ROOT, "tools", "rtlint", "baseline.json"))
+        for key in accepted:
+            assert not any(m in key for m in new_modules), \
+                f"grandfathered finding in a new module: {key}"
+        # and the scope is live, not vacuous: a sync planted in the
+        # module's path fires
+        findings = analyzer.run_analysis(
+            REPO_ROOT, package="ray_tpu", rules=("W6",),
+            files=[os.path.join(REPO_ROOT, m) for m in new_modules])
+        assert [f for f in findings if f.rule != "E0"] == [], \
+            "new sharded modules must stay sync-free"

@@ -10,8 +10,11 @@ to lock-step dispatch.  These bugs do not fail tests (results are
 identical); they only show up as a flat phase profile in bench.py.
 
 Scoped to ``ray_tpu/ops/``, ``ray_tpu/scheduling/``, and
-``ray_tpu/runtime/raylet.py`` (the code the heartbeat runs), the rule
-flags:
+``ray_tpu/runtime/raylet.py`` (the code the heartbeat runs) — which
+covers the mesh-sharded beat as well: ``ops/shard_reduce.py`` (the
+shard_map kernels + two-level ICI/DCN reduce, a sync-free module by
+contract) and ``scheduling/sharded_delta.py`` (whose per-shard staging
+inherits the same one-readback-per-beat budget).  The rule flags:
 
 - ``jax.device_get(...)`` — explicit device->host transfer;
 - ``<x>.block_until_ready(...)`` / ``jax.block_until_ready(...)`` —
