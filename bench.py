@@ -291,6 +291,44 @@ def sharded_delta_bench(n_nodes: int = 512, n_classes: int = 48,
     return rec
 
 
+def dispatch_lease_bench(num_nodes: int = 10000, jobs: int = 1000,
+                         tasks_per_job: int = 16, seed: int = 0,
+                         kill_head_at: float | None = 60.0) -> dict:
+    """The r15 tentpole surface: lease-plane dispatch throughput vs the
+    head-only path on the identical seeded job stream, plus the
+    hot-standby failover window (head SIGKILL mid-stream).  Pure
+    simulation over modeled head service time (sim/dispatch_bench.py)
+    — deterministic, replay-stable, no device needed."""
+    from ray_tpu.sim.dispatch_bench import run_dispatch_comparison
+    cmp_ = run_dispatch_comparison(num_nodes, jobs, tasks_per_job,
+                                   seed=seed, kill_head_at=kill_head_at)
+    rec = {
+        "nodes": num_nodes, "jobs": jobs,
+        "tasks": jobs * tasks_per_job, "seed": seed,
+        "speedup_vs_head_only": cmp_["speedup"],
+        "head_only_throughput_per_s":
+            cmp_["head_only"]["dispatch_throughput_per_s"],
+        "lease_throughput_per_s":
+            cmp_["lease"]["dispatch_throughput_per_s"],
+        "lease_hit_rate": cmp_["lease"]["lease_hit_rate"],
+        "spillbacks": cmp_["lease"]["spillbacks"],
+        "trace_hash_head_only": cmp_["head_only"]["trace_hash"],
+        "trace_hash_lease": cmp_["lease"]["trace_hash"],
+    }
+    fo = cmp_.get("failover")
+    if fo is not None:
+        rec["failover"] = {
+            "kill_head_at_s": kill_head_at,
+            "promotions": fo["promotions"],
+            "failover_ms": fo["failover_ms"],
+            "jobs_completed": fo["jobs_completed"],
+            "lease_hit_rate": fo["lease_hit_rate"],
+            "lease_revocations": fo["lease_revocations"],
+            "trace_hash": fo["trace_hash"],
+        }
+    return rec
+
+
 def _emit_smoke() -> None:
     """The --smoke entry: CPU-backend delta churn, one JSON line.
     Runs FIRST (subprocess, JAX_PLATFORMS=cpu) so every bench round
@@ -299,6 +337,8 @@ def _emit_smoke() -> None:
                               churn=8)
     sharded = sharded_delta_bench(n_nodes=128, n_classes=16, beats=12,
                                   churn=8)
+    dispatch = dispatch_lease_bench(num_nodes=64, jobs=40,
+                                    tasks_per_job=8, kill_head_at=None)
     ok = delta["oracle_parity"] and \
         sharded.get("bit_exact_fused_vs_sharded", True)
     print(json.dumps({
@@ -310,6 +350,7 @@ def _emit_smoke() -> None:
         "status": "smoke",
         "delta": delta,
         "sharded": sharded,
+        "dispatch": dispatch,
     }), flush=True)
 
 
@@ -402,7 +443,8 @@ def _cpu_fallback_p50(rounds: int = 5, reps: int = 3) -> float:
 
 def _emit_skipped(reason: str, cpu_p50: float | None = None,
                   delta: dict | None = None,
-                  sharded: dict | None = None) -> None:
+                  sharded: dict | None = None,
+                  dispatch: dict | None = None) -> None:
     """Graceful degradation for tunnel outages: one ``status:skipped``
     JSON line carrying the last-good device number (and the CPU
     fallback measurement when one ran) — instead of the old rc=3
@@ -424,6 +466,7 @@ def _emit_skipped(reason: str, cpu_p50: float | None = None,
             round(cpu_p50, 3) if cpu_p50 is not None else None,
         "delta": delta,
         "sharded": sharded,
+        "dispatch": dispatch,
     }), flush=True)
 
 
@@ -503,7 +546,17 @@ def main():
                 print(f"sharded delta fallback failed: {e!r}",
                       file=sys.stderr)
                 sharded = None
-            _emit_skipped(reason, cpu_p50, delta, sharded)
+            try:
+                # full acceptance scale: the sim needs no device
+                dispatch = dispatch_lease_bench(num_nodes=10000,
+                                                jobs=1000,
+                                                tasks_per_job=16,
+                                                kill_head_at=60.0)
+            except Exception as e:   # noqa: BLE001 — record, don't die
+                print(f"dispatch lease fallback failed: {e!r}",
+                      file=sys.stderr)
+                dispatch = None
+            _emit_skipped(reason, cpu_p50, delta, sharded, dispatch)
             return
         time.sleep(20.0)
 
@@ -601,6 +654,11 @@ def main():
         "sharded": sharded_delta_bench(n_nodes=N_NODES,
                                        n_classes=N_CLASSES,
                                        beats=20, churn=32),
+        # the r15 tentpole surface: lease-plane dispatch + failover
+        # (pure sim — the same numbers with or without the device)
+        "dispatch": dispatch_lease_bench(num_nodes=10000, jobs=1000,
+                                         tasks_per_job=16,
+                                         kill_head_at=60.0),
     }))
 
 

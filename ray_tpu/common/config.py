@@ -429,6 +429,65 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         float, 3.0,
         "Virtual delay between an autoscaler launch decision and the "
         "new simulated node registering."),
+    # -- lease plane (ray_tpu/leasing/) -------------------------------------
+    "lease_plane_enabled": (
+        bool, True,
+        "Grant steady-state worker leases at the raylet from an "
+        "epoch-stamped snapshot leased by the head (ray_tpu/leasing/); "
+        "misses and conflicts spill back to the head's scheduler, "
+        "which stays the single source of truth."),
+    "lease_budget_per_class": (
+        int, 0,
+        "Concurrent local admissions a raylet may grant per resource "
+        "class from its lease before spilling back to the head; 0 "
+        "derives the budget from node capacity."),
+    "lease_max_classes": (
+        int, 64,
+        "Resource classes a single node's lease snapshot may cover; "
+        "beyond it, least-recently-granted classes are evicted and "
+        "their submissions spill back."),
+    "lease_ttl_s": (
+        float, 30.0,
+        "Lease snapshot time-to-live: a raylet that has not confirmed "
+        "head contact within the death-declaration horizon fences "
+        "itself (stops granting locally); the head waits this long "
+        "after a leased task's last report before revoking the node's "
+        "epoch and requeueing."),
+    "lease_overcommit": (
+        float, 2.0,
+        "Total locally-admitted tasks (running + locally queued) a "
+        "raylet accepts, as a multiple of its concurrent capacity, "
+        "before spilling the overflow back to the head."),
+    "lease_submit_batch_max": (
+        int, 64,
+        "Upper bound on worker submissions coalesced into one framed "
+        "multi-submit per agent pump cycle on the raw-frame channel."),
+    # -- hot-standby head (runtime/standby.py) ------------------------------
+    "standby_probe_interval_s": (
+        float, 1.0,
+        "How often the hot-standby head probes the primary (and "
+        "re-tails the persisted job table + journal sidecar)."),
+    "standby_probe_misses": (
+        int, 3,
+        "Consecutive failed probes before the standby considers the "
+        "primary dead (its own veto in the promotion quorum)."),
+    "standby_quorum": (
+        float, 0.34,
+        "Fraction of known raylets whose head-down votes (plus the "
+        "standby's own failed probe) promote the standby; guards "
+        "against promotion on an asymmetric partition that only "
+        "isolates the standby."),
+    "sim_lease_plane": (
+        bool, False,
+        "Route simulated dispatch through the lease plane (origin-node "
+        "batched submits, local grants, spillback, epoch revocation) "
+        "instead of one head exec RPC per task; off by default so "
+        "pre-r15 campaign trace hashes replay unchanged."),
+    "sim_standby": (
+        bool, False,
+        "Run a simulated hot-standby head that is promoted by node "
+        "vote quorum after a head kill (head_failover_storm enables "
+        "this)."),
     # -- observability ------------------------------------------------------
     "metrics_export_port": (int, 0, "0 disables the Prometheus endpoint."),
     "dashboard_port": (int, 0, "0 disables the dashboard HTTP server."),

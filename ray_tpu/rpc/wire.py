@@ -219,6 +219,49 @@ def recv_reply(sock: socket.socket, sink_for=None):
     return req_id, ok, RawReply(meta, memoryview(body))
 
 
+# -- batched submits (the lease plane's fast-path wire format) ---------------
+# One framed multi-submit coalesces every worker submission drained in a
+# single agent pump cycle into one upward frame: marker byte + count,
+# then length-prefixed serialized entries.  0x01 cannot collide with
+# either existing first byte on the channel (0x80 pickle PROTO, 0x00
+# RAW_MARKER).
+MULTI_SUBMIT_MARKER = 0x01
+_MSUB_HDR = struct.Struct(">BI")
+_MSUB_LEN = struct.Struct(">I")
+
+
+def pack_multi_submit(entries) -> bytes:
+    """``entries`` is a list of already-serialized frame payloads (each
+    one worker ``submit`` tuple).  Returns one frame payload carrying
+    them all."""
+    parts = [_MSUB_HDR.pack(MULTI_SUBMIT_MARKER, len(entries))]
+    for e in entries:
+        parts.append(_MSUB_LEN.pack(len(e)))
+        parts.append(bytes(e))
+    return b"".join(parts)
+
+
+def is_multi_submit(frame) -> bool:
+    return len(frame) > 0 and frame[0] == MULTI_SUBMIT_MARKER
+
+
+def unpack_multi_submit(frame) -> list[bytes]:
+    """The individual serialized entries packed by ``pack_multi_submit``
+    (round-trip exact: bytes in == bytes out, order preserved)."""
+    _marker, count = _MSUB_HDR.unpack_from(frame, 0)
+    off = _MSUB_HDR.size
+    out = []
+    for _ in range(count):
+        (n,) = _MSUB_LEN.unpack_from(frame, off)
+        off += _MSUB_LEN.size
+        out.append(bytes(frame[off:off + n]))
+        off += n
+    if off != len(frame):
+        raise ConnectionError(
+            f"multi-submit frame has {len(frame) - off} trailing bytes")
+    return out
+
+
 def send_frame(sock: socket.socket, obj) -> None:
     send_raw_frame(sock, serialize(obj))
 

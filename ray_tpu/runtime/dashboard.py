@@ -89,6 +89,12 @@ class Dashboard(BackgroundHTTPServer):
             return {f"{r}:{i}": text for (r, i), text in got.items()}
         if name == "jobs":
             return self._jobs.list() if self._jobs is not None else []
+        if name == "leases":
+            try:
+                from ..leasing import aggregate_stats
+                return aggregate_stats()
+            except Exception:   # noqa: BLE001 — lease plane disabled
+                return {}
         if name == "serve":
             out = {}
             try:
@@ -227,6 +233,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
             '<a href="/api/serve">serve</a> · '
+            '<a href="/api/leases">leases</a> · '
             '<a href="/api/broadcasts">broadcasts</a> · '
             '<a href="/api/health">health</a> · '
             '<a href="/api/stacks">stacks</a> · '

@@ -361,6 +361,8 @@ class HeadNode:
         cluster = self._rt.cluster
         return {
             "address": self.address,
+            "role": "primary",
+            "leasing": self._leasing_stats(),
             "xlang_address": self.xlang.address if self.xlang else None,
             "dashboard_url": (cluster.dashboard.url
                               if cluster.dashboard else None),
@@ -378,6 +380,14 @@ class HeadNode:
             "health": self._health_stats(cluster),
             "chaos": self._chaos_stats(),
         }
+
+    @staticmethod
+    def _leasing_stats() -> dict:
+        try:
+            from ..leasing import aggregate_stats
+            return aggregate_stats()
+        except Exception:   # noqa: BLE001 — lease plane disabled
+            return {}
 
     @staticmethod
     def _health_stats(cluster) -> dict:

@@ -252,6 +252,35 @@ def render_metrics(cluster) -> str:
              ls["last_reclaim_latency_s"],
              "Drain-to-restore latency of the last reclaim", out=out)
 
+    # lease plane (process-local registry: agent cache, head grantor,
+    # standby — whichever roles live in this process)
+    try:
+        from ..leasing import aggregate_stats
+        lz = aggregate_stats()
+    except Exception:   # noqa: BLE001 — lease plane disabled
+        lz = {}
+    if lz.get("sources"):
+        _fmt("leases_granted_local", lz["leases_granted_local"],
+             "Tasks admitted from a local lease, no head RPC "
+             "(cumulative)", out=out)
+        _fmt("spillbacks", lz["spillbacks"],
+             "Lease misses spilled back to the head (cumulative)",
+             out=out)
+        _fmt("lease_revocations", lz["lease_revocations"],
+             "Grants revoked by epoch advance (cumulative)", out=out)
+        _fmt("lease_hit_rate", lz["lease_hit_rate"],
+             "Local-grant fraction of lease decisions", out=out)
+        standby = lz["sources"].get("standby") or {}
+        if standby:
+            _fmt("standby_promotions_total",
+                 standby.get("promotions", 0),
+                 "Standby-to-primary promotions (cumulative)", out=out)
+            fo = standby.get("failover_ms") or []
+            if fo:
+                _fmt("failover_ms", fo[-1],
+                     "Head-death to promoted-head-serving window of "
+                     "the last failover", out=out)
+
     # user-defined metrics (ray_tpu.util.metrics) share the endpoint
     from ..util.metrics import render_user_metrics
     out.extend(render_user_metrics())

@@ -66,6 +66,14 @@ _EOF = object()
 DEFAULT_NODE_RESOURCES = {"CPU": 2, "memory": 2}
 
 
+def _lease_class_key(cu: dict) -> str:
+    """Interned resource-class key: tasks with identical demand vectors
+    share one leased budget (the repeat-class steady state the lease
+    plane serves).  Shared by the agent's admission and the head's
+    grant bookkeeping — both sides must intern identically."""
+    return ",".join(f"{k}:{cu[k]}" for k in sorted(cu)) or "zero"
+
+
 def _make_agent_arena(session_dir: str):
     """The agent machine's own arena (plasma analogue): /dev/shm when
     available, session dir otherwise — mirrors the head's
@@ -132,16 +140,22 @@ class NodeAgent:
                  num_workers: int = 2,
                  labels: dict[str, str] | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 reconnect_timeout_s: float = 0.0):
+                 reconnect_timeout_s: float = 0.0,
+                 standby_address: str | None = None):
         """``reconnect_timeout_s`` > 0 makes the agent survive a head
         restart: on link loss it retries the head address for that long
         and re-registers as a fresh node (local workers of the dead
         head's pool are reaped, the local store resets — the restarted
-        head has no directory entries for it)."""
+        head has no directory entries for it).  ``standby_address``
+        names a hot-standby head (``runtime/standby.py``): on link loss
+        the agent casts a head-down vote there, the quorum input that
+        lets the standby promote within one probe interval instead of
+        waiting out its own miss threshold."""
         from ..rpc import transport as _transport
         from .object_plane import ObjectPlane
         from .object_store import MemoryStore
         self._head_address = head_address
+        self._standby_address = standby_address
         self._resources = resources
         self._num_workers = num_workers
         self._labels = labels
@@ -212,6 +226,34 @@ class NodeAgent:
         self._sync_batch: list = []
         self._sync_wake = threading.Event()
         self._sync_thread: threading.Thread | None = None
+        # -- lease plane (ray_tpu/leasing/): raylet-side grant authority.
+        # Admission checks the epoch-stamped class budgets the head
+        # leased to this node; a miss relays the submit to the head
+        # (spillback) and rides the next sync as a lease request so the
+        # rest of the fan-out fast-paths.  The fence horizon equals the
+        # head's quiet-lease TTL: the node stops granting at or before
+        # the moment the head revokes its epoch.
+        from ..common.config import get_config
+        cfg = get_config()
+        self._lease = None
+        self._lease_lock = threading.Lock()     # after _view_lock only
+        self._lease_want: set[str] = set()
+        self._last_sync_call = _clk.monotonic()
+        self._msub_batches = 0
+        self._msub_frames = 0
+        self._msub_max = max(int(cfg.lease_submit_batch_max), 1)
+        if cfg.lease_plane_enabled:
+            from ..leasing import LocalLeaseCache, register_stats
+            # capacity == the dispatch-queue bound: per-class budgets
+            # (head-issued) are the binding admission limit; the
+            # overcommit multiple of the queue cap is the backstop
+            self._lease = LocalLeaseCache(
+                capacity=self._LOCAL_QUEUE_CAP,
+                fence_after_s=float(cfg.lease_ttl_s),
+                overcommit=float(cfg.lease_overcommit),
+                max_classes=int(cfg.lease_max_classes))
+            self._lease.on_head_contact(_clk.monotonic())
+            register_stats("agent", self._lease_stats)
         handlers = {
             "a_spawn": self._a_spawn,
             "a_send": self._a_send,
@@ -285,6 +327,30 @@ class NodeAgent:
             # a push that raced in DURING registration is newer than
             # the reply's snapshot — don't overwrite it
             self._fast_enabled = fast
+        lease = reply.get("lease") if isinstance(reply, dict) else None
+        if self._lease is not None and lease is not None:
+            with self._lease_lock:
+                self._lease.on_head_contact(_clk.monotonic())
+                epoch = int(lease.get("epoch", 0))
+                self._lease.observe_epoch(epoch)
+                self._lease.install(lease.get("grants") or {}, epoch)
+
+    def _lease_stats(self) -> dict:
+        """The node-side half of the observability satellite: lease
+        cache counters + the pump's multi-submit batching counters."""
+        s = self._lease.stats() if self._lease is not None else {}
+        s["submit_batches"] = self._msub_batches
+        s["submit_batched_frames"] = self._msub_frames
+        return s
+
+    def _lease_release(self, entry: dict) -> None:
+        """A locally-admitted entry left the local system (done, error,
+        handback): return its class admission.  Pop-once: every exit
+        path may call this safely."""
+        ck = entry.pop("lease_ck", None)
+        if ck is not None and self._lease is not None:
+            with self._lease_lock:
+                self._lease.release(ck)
 
     def _a_policy(self, policy: dict) -> bool:
         """Head policy push (e.g. a job-level runtime_env appearing
@@ -308,7 +374,29 @@ class NodeAgent:
         return len(handed)
 
     # -- head failover -------------------------------------------------------
+    def _vote_standby(self) -> None:
+        """The head link dropped: cast a head-down vote at the hot
+        standby (``runtime/standby.py``).  One agent vote plus the
+        standby's own failed probe is enough to promote — sub-
+        heartbeat failover instead of waiting out the miss threshold.
+        Best-effort: no standby configured / reachable, no vote."""
+        if not self._standby_address:
+            return
+        from ..rpc import transport as _transport
+        try:
+            c = _transport.connect(self._standby_address)
+            try:
+                c.call("standby_vote", getattr(self, "agent_id", ""),
+                       timeout=5.0)
+            finally:
+                c.close()
+        except Exception:   # noqa: BLE001 — standby gone too: the
+            pass            # reconnect loop still covers recovery
+
     def _on_head_lost(self) -> None:
+        if self._standby_address and not self._stopping:
+            threading.Thread(target=self._vote_standby, daemon=True,
+                             name="agent-standby-vote").start()
         if self._stopping or self._reconnect_timeout <= 0:
             self._stop_event.set()
             return
@@ -384,9 +472,15 @@ class NodeAgent:
         entries = list(self._local_tasks.values())
         self._local_tasks.clear()
         with self._view_lock:
+            queued = list(self._local_queue)
             self._local_queue.clear()
+            self._lease_want.clear()
         for e in entries:
             self.store.unpin(e["pins"])
+        for e in entries:
+            self._lease_release(e)
+        for e in queued:
+            self._lease_release(e)
         self._head_tasks.clear()
         self._fn_uploaded.clear()       # the new head has a fresh registry
         with self._small_cache_lock:
@@ -490,6 +584,9 @@ class NodeAgent:
             self._arena.close()
         except Exception:       # noqa: BLE001
             pass
+        if self._lease is not None:
+            from ..leasing import unregister_stats
+            unregister_stats("agent")
         shutil.rmtree(self._session_dir, ignore_errors=True)
         self._stop_event.set()
         return "stopping"
@@ -783,10 +880,26 @@ class NodeAgent:
             for k, v in cu.items():
                 if self._totals_cu.get(k, 0) < v:
                     return False    # infeasible here, ever
+            ck = None
+            if self._lease is not None:
+                # the lease-plane admission proper: grant locally only
+                # inside the epoch-stamped budget the head leased for
+                # this class; a miss SPILLS BACK (the relayed submit
+                # is the spillback) and requests the class on the next
+                # sync so the rest of the fan-out fast-paths
+                ck = _lease_class_key(cu)
+                with self._lease_lock:
+                    granted = self._lease.try_grant(ck,
+                                                    _clk.monotonic())
+                if not granted:
+                    if len(self._lease_want) < 256:
+                        self._lease_want.add(ck)
+                    self._sync_wake.set()
+                    return False
             entry = {"spec": spec, "spec_bytes": spec_bytes,
                      "fn_id": fn_id, "fn_bytes": fn_bytes,
                      "submitter": submitter, "cu": cu,
-                     "enq": _clk.monotonic()}
+                     "lease_ck": ck, "enq": _clk.monotonic()}
             # started rides the sync BEFORE any dispatch: the result
             # can arrive arbitrarily fast, and its done entry must
             # never reach the head in a flush preceding registration.
@@ -823,6 +936,7 @@ class NodeAgent:
             for e in list(self._local_queue):
                 if e["spec"].task_id.binary() == tid_bin:
                     self._local_queue.remove(e)
+                    self._lease_release(e)
                     return "dequeued"
         entry = self._local_tasks.get(tid_bin)
         if entry is None:
@@ -990,6 +1104,7 @@ class NodeAgent:
 
     def _finish_local(self, entry, descs, contained, err_bytes,
                       disposition: str) -> None:
+        self._lease_release(entry)
         with self._sync_lock:
             self._sync_batch.append(
                 ("done", entry["spec"].task_id.binary(), descs,
@@ -1104,23 +1219,96 @@ class NodeAgent:
             with self._sync_lock:
                 batch = self._sync_batch
                 self._sync_batch = []
-            if not batch:
+            want = None
+            if self._lease is not None:
+                with self._view_lock:
+                    if self._lease_want:
+                        want = sorted(self._lease_want)
+                        self._lease_want.clear()
+                if want is None and not batch and \
+                        now - self._last_sync_call > \
+                        self._lease.fence_after_s / 3.0:
+                    # lease keepalive: a fenced cache spills EVERYTHING,
+                    # so an idle agent still confirms head contact well
+                    # inside the fence horizon (and folds fresh
+                    # grants/epochs while it's there)
+                    want = []
+            if not batch and want is None:
                 continue
             load: dict[str, int] = {}
             for e in list(self._local_tasks.values()):
                 for k, v in e["cu"].items():
                     load[k] = load.get(k, 0) + v
             try:
-                self._head.call("agent_sync", self.agent_id, batch,
-                                load)
+                reply = self._head.call("agent_sync", self.agent_id,
+                                        batch, load, want)
+                self._last_sync_call = _clk.monotonic()
+                self._fold_sync_reply(reply)
             except Exception:   # noqa: BLE001 — head gone: the
                 # on_close/reconnect flow owns cleanup; log so a sync
                 # silently failing for OTHER reasons is visible
                 _LOG.debug("agent_sync to head failed", exc_info=True)
 
+    def _fold_sync_reply(self, reply) -> None:
+        """Lease half of the sync reply: a confirmed head contact, the
+        node's current epoch, and fresh grants.  An epoch ADVANCE means
+        the head revoked this node's grant set (quiet lease / drain /
+        re-admission): the head has already requeued everything it
+        registered, so locally-queued not-yet-started grants hand back
+        for global placement rather than running under a dead epoch."""
+        if self._lease is None or not isinstance(reply, dict):
+            return
+        epoch = int(reply.get("epoch", 0))
+        with self._lease_lock:
+            self._lease.on_head_contact(_clk.monotonic())
+            revoked = self._lease.observe_epoch(epoch)
+            grants = reply.get("grants")
+            if grants:
+                self._lease.install(grants, epoch)
+        if revoked:
+            with self._view_lock:
+                handed = list(self._local_queue)
+                self._local_queue.clear()
+            for e in handed:
+                e.pop("lease_ck", None)     # epoch bump zeroed budgets
+                self._finish_local(e, None, None, None, "requeue")
+
     # -- worker->head pump ---------------------------------------------------
+    def _relay_up(self, index: int, frames: list) -> bool:
+        """Relay rewritten frames to the head IN ORDER, packing every
+        run of >= 2 consecutive spilled ``submit`` frames into ONE
+        framed multi-submit (``rpc/wire.pack_multi_submit``): a burst
+        of N lease misses costs one head frame, not N.  Returns False
+        when the head link is gone (the pump stops)."""
+        from ..rpc import wire
+        from .serialization import serialize
+        i, n = 0, len(frames)
+        while i < n:
+            msg = frames[i]
+            j = i + 1
+            if self._lease is not None and msg[0] == "submit":
+                while j < n and frames[j][0] == "submit":
+                    j += 1
+            if j - i >= 2:
+                packed = wire.pack_multi_submit(
+                    [serialize(f) for f in frames[i:j]])
+                self._msub_batches += 1
+                self._msub_frames += j - i
+                msg = ("msub", packed)
+            try:
+                # explicit no-deadline: a large result frame draining
+                # slowly is not a dead head; loss raises via on_close
+                self._head.call("agent_frame", self.agent_id, index,
+                                msg, timeout=None)
+            except Exception:   # noqa: BLE001 — head gone: nothing to
+                return False    # relay to; the on_close hook is
+                #                 already ending the agent
+            i = j
+        return True
+
     def _pump(self, index: int, conn, epoch: int = 0) -> None:
-        while True:
+        eof = False
+        while not eof:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
@@ -1128,23 +1316,30 @@ class NodeAgent:
             if self._epoch != epoch:
                 return      # stale worker of a replaced head: its index
                 #             collides with the new pool's — go quiet
-            try:
-                msg = self._rewrite_up(index, msg)
-            except Exception:   # noqa: BLE001 — surgery must not drop
-                # the frame; forward as-is, but a failing rewrite is a
-                # protocol bug worth surfacing
-                _LOG.warning("frame rewrite failed; forwarding raw",
-                             exc_info=True)
-            if msg is None:
-                continue        # fully handled locally (autonomy path)
-            try:
-                # explicit no-deadline: a large result frame draining
-                # slowly is not a dead head; loss raises via on_close
-                self._head.call("agent_frame", self.agent_id, index,
-                                msg, timeout=None)
-            except Exception:   # noqa: BLE001 — head gone: nothing to
-                return          # relay to; the on_close hook is already
-                #                 ending the agent
+            msgs = [msg]
+            if self._lease is not None:
+                # greedy drain: every frame the worker already piped
+                # this cycle rides one relay burst, so a fan-out's
+                # consecutive spilled submits coalesce into one
+                # multi-submit frame instead of one head RPC each
+                try:
+                    while len(msgs) < self._msub_max and conn.poll(0):
+                        msgs.append(conn.recv())
+                except (EOFError, OSError):
+                    eof = True
+            out = []
+            for m in msgs:
+                try:
+                    m = self._rewrite_up(index, m)
+                except Exception:   # noqa: BLE001 — surgery must not
+                    # drop the frame; forward as-is, but a failing
+                    # rewrite is a protocol bug worth surfacing
+                    _LOG.warning("frame rewrite failed; forwarding raw",
+                                 exc_info=True)
+                if m is not None:
+                    out.append(m)
+            if out and not self._relay_up(index, out):
+                return
         if self._epoch != epoch:
             return          # stale: do NOT EOF the new pool's worker
         self._release_index_pins(index)
@@ -1349,10 +1544,56 @@ class AgentHub:
     attach to any server fronting a cluster) — it also exposes the
     head's object plane so agents can pull head-resident objects."""
 
+    _EPOCH_KEY = b"lease_epochs"
+    _EPOCH_NS = "_lease"
+
     def __init__(self, cluster):
+        from ..common.config import get_config
         self._cluster = cluster
         self._agents: dict[str, tuple[AgentSpawner, object]] = {}
+        self._agent_workers: dict[str, int] = {}
         self._lock = threading.Lock()
+        # -- lease plane: the head-side single source of truth --------------
+        cfg = get_config()
+        self._grantor = None
+        self._cfg_budget = int(cfg.lease_budget_per_class)
+        self._lease_overcommit = float(cfg.lease_overcommit)
+        self._epoch_tab: dict[str, int] = {}
+        if cfg.lease_plane_enabled:
+            from ..leasing import LeaseGrantor, register_stats
+            self._grantor = LeaseGrantor(
+                budget_per_class=self._cfg_budget or 64,
+                max_classes=int(cfg.lease_max_classes),
+                journal=self._journal_epoch)
+            self._restore_epochs()
+            register_stats("head_grantor", self._grantor.stats)
+
+    # -- epoch journal (rides the persisted GCS snapshot's KV plane) --------
+    def _journal_epoch(self, node: str, epoch: int) -> None:
+        """Revocation epochs persist through the cluster KV, which the
+        GCS snapshot covers: a promoted standby restores the table and
+        never re-issues an epoch the dead head already revoked — how
+        outstanding leases survive failover."""
+        import json
+        self._epoch_tab[node] = int(epoch)
+        try:
+            self._cluster.kv.put(
+                self._EPOCH_KEY, json.dumps(self._epoch_tab).encode(),
+                namespace=self._EPOCH_NS)
+        except Exception:   # noqa: BLE001 — journal loss degrades to
+            pass            # the fence horizon, never to a crash
+
+    def _restore_epochs(self) -> None:
+        import json
+        try:
+            raw = self._cluster.kv.get(self._EPOCH_KEY,
+                                       namespace=self._EPOCH_NS)
+            if raw:
+                self._epoch_tab = {str(k): int(v) for k, v
+                                   in json.loads(bytes(raw)).items()}
+                self._grantor.restore(self._epoch_tab)
+        except Exception:   # noqa: BLE001 — corrupt journal: start
+            self._epoch_tab = {}        # fresh (fencing still holds)
 
     def handlers(self) -> dict:
         return {
@@ -1433,23 +1674,47 @@ class AgentHub:
                 pass
             raise ConnectionError("agent disconnected during "
                                   "registration")
-        return {"node_id": node_id.hex(),
-                "resources": resources or dict(DEFAULT_NODE_RESOURCES),
-                "fast_path": not bool(self._cluster.job_runtime_env)}
+        with self._lock:
+            self._agent_workers[agent_id] = int(num_workers)
+        out = {"node_id": node_id.hex(),
+               "resources": resources or dict(DEFAULT_NODE_RESOURCES),
+               "fast_path": not bool(self._cluster.job_runtime_env)}
+        if self._grantor is not None:
+            ep, grants = self._grantor.snapshot_for(agent_id)
+            out["lease"] = {"epoch": ep, "grants": grants}
+        return out
 
     def frame(self, agent_id: str, index: int, msg) -> None:
         entry = self._agents.get(agent_id)
-        if entry is not None:
-            entry[0].feed_frame(index, msg)
+        if entry is None:
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "msub":
+            # one framed multi-submit off the agent's pump: unpack the
+            # individual worker submit frames (order preserved — the
+            # wire round-trip is byte-exact) and feed them as if they
+            # had arrived one frame each
+            from ..rpc import wire
+            from .serialization import deserialize
+            for raw in wire.unpack_multi_submit(msg[1]):
+                entry[0].feed_frame(index, deserialize(raw))
+            return
+        entry[0].feed_frame(index, msg)
 
     # -- autonomy sync (ordered refs/started/done batch from an agent) ------
-    def sync(self, agent_id: str, batch: list, load: dict) -> bool:
+    def sync(self, agent_id: str, batch: list, load: dict,
+             lease_want=None):
         """Fold an agent's autonomous-dispatch batch into the head's
         authority, IN ORDER: ref-count events, started specs
         (ownership, lineage), done results (seal + complete +
         reconcile), then the node's live local load.  The per-lease
         head cost is this amortized call — the lease itself never
-        touched the head."""
+        touched the head.
+
+        ``lease_want`` lists resource classes the agent spilled since
+        its last sync: the grantor leases them (bounded per node) and
+        the reply carries the node's current epoch + grant snapshot, so
+        one spillback converts the whole rest of a repeat-class stream
+        into local grants."""
         entry = self._agents.get(agent_id)
         if entry is None or entry[1] is None:
             return False
@@ -1473,6 +1738,14 @@ class AgentHub:
                 self._sync_done(cluster, raylet, row, item)
         raylet.agent_local_cu = dict(load) if load else None
         raylet._notify_dirty()
+        if self._grantor is not None:
+            budget = self._cfg_budget or max(
+                64, int(self._agent_workers.get(agent_id, 2) *
+                        self._lease_overcommit))
+            for ck in list(lease_want or ())[:32]:
+                self._grantor.grant(agent_id, str(ck), budget)
+            ep, grants = self._grantor.snapshot_for(agent_id)
+            return {"ok": True, "epoch": ep, "grants": grants}
         return True
 
     def _sync_started(self, cluster, raylet, row: int,
@@ -1574,12 +1847,21 @@ class AgentHub:
             agents = list(self._agents)
         for agent_id in agents:
             self._on_agent_lost(agent_id)
+        if self._grantor is not None:
+            from ..leasing import unregister_stats
+            unregister_stats("head_grantor")
 
     def _on_agent_lost(self, agent_id: str) -> None:
         with self._lock:
             entry = self._agents.pop(agent_id, None)
+            self._agent_workers.pop(agent_id, None)
         if entry is None:
             return
+        if self._grantor is not None:
+            # node left (death, bye, shutdown): revoke its epoch so a
+            # re-registration under the same id can never reuse grants
+            # (journaled — survives head kill and standby promotion)
+            self._grantor.drop_node(agent_id)
         spawner, node_id = entry
         # drain first so the raylet stops dispatching into the void,
         # then drop the link; remove_node tolerates an already-gone node

@@ -2164,6 +2164,12 @@ class Raylet:
         if rec is None:
             return
         self.crm.add_back(self.row, rec.spec.resources)
+        if rec.done:
+            # completed elsewhere (a force-cancel sealed it before the
+            # kill): only the resource refund above was still owed —
+            # re-sealing would clobber the cancellation error
+            self._notify_dirty()
+            return
         if self.task_manager.should_retry(task_id):
             self._enqueue(task_id)
         else:
@@ -2252,6 +2258,11 @@ class Raylet:
                     self._cancel_seal_and_complete(task_id)
                     return True
         if entry is not None and force:
+            # seal FIRST (exactly like the agent-leased branch below):
+            # the worker-death bookkeeping must find the record done
+            # and skip its retry — killing first would race the death
+            # path into resubmitting the cancelled task
+            self._cancel_seal_and_complete(task_id)
             self.pool.kill_worker(entry[1])  # death path does bookkeeping
             return True
         # agent-leased task (autonomous dispatch): ask the agent what

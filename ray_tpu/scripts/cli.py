@@ -81,7 +81,9 @@ def cmd_agent(args) -> int:
     agent = NodeAgent(args.address, resources=resources,
                       num_workers=num_workers, labels=labels,
                       reconnect_timeout_s=getattr(
-                          args, "reconnect_timeout", 60.0))
+                          args, "reconnect_timeout", 60.0),
+                      standby_address=getattr(
+                          args, "standby_address", None))
     print(f"ray_tpu node agent joined {args.address} as node "
           f"{agent.node_id_hex[:16]}… ({num_workers} workers)",
           flush=True)
@@ -89,6 +91,25 @@ def cmd_agent(args) -> int:
         agent.wait_for_shutdown()
     except KeyboardInterrupt:
         agent.stop()
+    return 0
+
+
+def cmd_standby(args) -> int:
+    """Foreground hot-standby head: probes the primary, collects agent
+    head-down votes, and promotes itself onto the primary's address
+    from the shared persist snapshot when the primary dies."""
+    from ..runtime.standby import StandbyHead
+    resources = json.loads(args.resources) if args.resources else None
+    standby = StandbyHead(args.address, port=args.port,
+                          persist_path=getattr(args, "persist", None),
+                          resources=resources,
+                          num_workers=args.num_workers)
+    print(f"ray_tpu standby armed at {standby.address}, "
+          f"watching {args.address}", flush=True)
+    try:
+        standby.wait_for_shutdown()
+    except KeyboardInterrupt:
+        standby.stop()
     return 0
 
 
@@ -185,7 +206,7 @@ def cmd_status(args) -> int:
         st = client.call("status", timeout=30.0)
     finally:
         client.close()
-    print(f"address: {st['address']}")
+    print(f"address: {st['address']}  role: {st.get('role', 'primary')}")
     print(f"session: {st['session_dir']}")
     print(f"nodes ({len(st['nodes'])}):")
     for n in st["nodes"]:
@@ -259,6 +280,18 @@ def cmd_status(args) -> int:
             print(f"  chunks relayed={op2['bcast_chunks_relayed']} "
                   f"pulled={op2['bcast_chunks_pulled']} "
                   f"sealed-served={op2['bcast_chunks_sealed_served']}")
+    lz = st.get("leasing") or {}
+    if lz.get("sources"):
+        print(f"leasing: hit_rate={lz.get('lease_hit_rate', 0.0)} "
+              f"local={lz.get('leases_granted_local', 0)} "
+              f"spillbacks={lz.get('spillbacks', 0)} "
+              f"revocations={lz.get('lease_revocations', 0)} "
+              f"issued={lz.get('leases_issued', 0)}")
+        sb = lz["sources"].get("standby") or {}
+        if sb:
+            print(f"  standby: role={sb.get('role')} "
+                  f"promotions={sb.get('promotions', 0)} "
+                  f"failover_ms={sb.get('failover_ms')}")
     if st["jobs"]:
         print(f"jobs ({len(st['jobs'])}):")
         for j in st["jobs"]:
@@ -600,10 +633,27 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--resources", default=None)
     pa.add_argument("--num-workers", type=int, default=2)
     pa.add_argument("--labels", default=None, help="JSON node labels")
+    pa.add_argument("--standby-address", default=None,
+                    help="hot-standby head to vote at on head-link "
+                         "loss (sub-heartbeat failover)")
     pa.add_argument("--reconnect-timeout", type=float, default=60.0,
                     help="seconds to retry a lost head before exiting "
                          "(0 disables; survives head restarts)")
     pa.set_defaults(fn=cmd_agent)
+
+    psb = sub.add_parser(
+        "standby",
+        help="run a hot-standby head watching a primary")
+    psb.add_argument("--address", required=True,
+                     help="primary head host:port to watch")
+    psb.add_argument("--port", type=int, default=0,
+                     help="standby vote/status port (0 = ephemeral)")
+    psb.add_argument("--persist", default=None,
+                     help="the PRIMARY's persist snapshot path; the "
+                          "promoted head restores from it")
+    psb.add_argument("--resources", default=None)
+    psb.add_argument("--num-workers", type=int, default=None)
+    psb.set_defaults(fn=cmd_standby)
 
     pst = sub.add_parser("stop", help="stop the running cluster")
     pst.add_argument("--address", default=None)

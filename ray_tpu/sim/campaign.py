@@ -21,9 +21,11 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from dataclasses import replace
+
 from ..rpc.chaos import _Params
 from ..rpc.client import RpcConnectionError
-from .cluster import HEAD_ADDR, SimCluster, SimParams
+from .cluster import HEAD_ADDR, STANDBY_ADDR, SimCluster, SimParams
 from .invariants import check_invariants
 
 __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
@@ -31,7 +33,12 @@ __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
 
 CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
              "drain_churn", "autoscaler_flap", "broadcast_storm",
-             "serve_diurnal")
+             "serve_diurnal", "head_failover_storm")
+
+# the failover storm snaps task durations to a small class set so the
+# job stream is a repeat-class workload — the shape the lease plane's
+# origin routing serves locally
+_STORM_CLASSES = (2.0, 4.0, 6.0, 9.0, 12.0, 15.0)
 
 _SETTLE_CAP_S = 900.0       # virtual budget for the quiesce phase
 
@@ -90,14 +97,22 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
     if campaign not in CAMPAIGNS:
         raise ValueError(f"unknown campaign {campaign!r}; "
                          f"choose from {', '.join(CAMPAIGNS)}")
+    storm = campaign == "head_failover_storm"
     jobs = []
     n_jobs = max(8, min(400, num_nodes // 4))
     for k in range(n_jobs):
         t = float(rng.uniform(1.0, duration * 0.7))
         n_tasks = int(rng.integers(2, 9))
         jid = f"job{k:04d}"
-        tasks = {f"{jid}.t{i}": round(float(rng.uniform(2.0, 18.0)), 3)
-                 for i in range(n_tasks)}
+        if storm:
+            tasks = {f"{jid}.t{i}":
+                     _STORM_CLASSES[int(rng.integers(
+                         0, len(_STORM_CLASSES)))]
+                     for i in range(n_tasks)}
+        else:
+            tasks = {f"{jid}.t{i}":
+                     round(float(rng.uniform(2.0, 18.0)), 3)
+                     for i in range(n_tasks)}
         jobs.append((t, jid, tasks))
     jobs.sort(key=lambda e: e[0])
 
@@ -121,6 +136,13 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
         # machine and request re-dispatch under fire
         "serve_diurnal": (("kill_node", 0.5), ("gray_slow", 0.2),
                           ("drain", 0.2), ("kill_head", 0.1)),
+        # rolling head SIGKILLs under churn + asymmetric partitions:
+        # no scripted restarts — the hot standby must promote every
+        # time, and the lease plane must keep dispatching through it
+        "head_failover_storm": (("kill_head", 0.35),
+                                ("partition", 0.3),
+                                ("kill_node", 0.25),
+                                ("gray_slow", 0.1)),
     }
     ops, weights = zip(*mixes[campaign])
     sched = []
@@ -138,21 +160,26 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
         target = int(rng.integers(0, num_nodes))
         heal_after = float(rng.uniform(8.0, 25.0))
         if op == "kill_head":
-            if head_kills >= 2:     # bounded: restarts must not overlap
+            # bounded: restarts must not overlap (storm runs deeper —
+            # the standby chain absorbs each kill, no scripted restart)
+            if head_kills >= (4 if storm else 2):
                 op = "kill_node"
             else:
                 head_kills += 1
                 sched.append((t, "kill_head", {}))
-                sched.append((t + heal_after, "restart_head", {}))
+                if not storm:
+                    sched.append((t + heal_after, "restart_head", {}))
                 continue
         if op == "partition":
-            kind = int(rng.integers(0, 3))
+            kind = int(rng.integers(0, 4 if storm else 3))
             addr = _node_addr(target)
             if kind == 0:       # asymmetric: head cannot reach node
                 pairs = [(HEAD_ADDR, addr)]
             elif kind == 1:     # asymmetric: node cannot reach head
                 pairs = [(addr, HEAD_ADDR)]
-            else:               # full bidirectional cut
+            elif kind == 3:     # asymmetric: standby blind to a live
+                pairs = [(STANDBY_ADDR, HEAD_ADDR)]     # head (no
+            else:               # split-brain: nodes don't vote)
                 pairs = [(HEAD_ADDR, addr), (addr, HEAD_ADDR)]
             sched.append((t, "partition", {"pairs": pairs}))
             sched.append((t + heal_after, "heal", {"pairs": pairs}))
@@ -199,6 +226,10 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
     jobs, sched = build_schedule(campaign, rng, num_nodes, faults,
                                  duration)
 
+    if campaign == "head_failover_storm":
+        # the storm IS the lease plane + hot standby under fire
+        params = replace(params or SimParams.from_config(),
+                         lease_plane=True, standby=True)
     cluster = SimCluster(num_nodes, seed=seed, params=params)
     plane = None
     if campaign == "serve_diurnal":
@@ -314,7 +345,10 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
             # -- quiesce: heal the world, let recovery converge ----------
             cluster.chaos.partitions.clear()
             cluster.chaos.links.clear()
-            if cluster.head is None:
+            if cluster.head is None and cluster.standby is None:
+                # with a hot standby, promotion — not a scripted
+                # restart — brings the head back (racing start_head
+                # against it would double-bind the head address)
                 cluster.start_head()
             trace.rec(clock.monotonic(), "quiesce")
 

@@ -28,19 +28,35 @@ from dataclasses import dataclass
 
 from ..common.clock import VirtualClock
 from ..common.config import get_config
+from ..leasing import LeaseGrantor, LocalLeaseCache
 from ..rpc.breaker import CLOSED, OPEN, PeerBreaker
 from ..rpc.chaos import _Chaos
 from ..rpc.client import RpcConnectionError
 from .transport import SimTransport
 
 __all__ = ["SimCluster", "SimParams", "SimHead", "SimNode",
-           "SimAutoscaler", "Trace", "ALIVE", "DRAINING", "DEAD",
-           "REMOVED"]
+           "SimStandby", "SimAutoscaler", "Trace", "ALIVE", "DRAINING",
+           "DEAD", "REMOVED"]
 
 ALIVE, DRAINING, DEAD, REMOVED = "alive", "draining", "dead", "removed"
 HEAD_ADDR = "sim://head"
+STANDBY_ADDR = "sim://standby"
 
 _TRACE_EVENT_CAP = 20000        # stored events; the hash covers ALL
+
+# Modeled head service cost per RPC, in deterministic virtual
+# microseconds: the dispatch-throughput denominator.  A scheduling RPC
+# (grant, submit, done, spillback) runs the placement machinery +
+# serialization; an origin-routed batch forward is a route-table lookup
+# plus a send — no placement solve; a heartbeat (and a leased-row TTL
+# refresh) is a row-timestamp touch, two orders cheaper; batched ops
+# pay a small marginal per item.  The ratio of lease-plane to head-only
+# throughput is a pure function of these constants and the RPC counts —
+# replay-stable by construction.
+_HEAD_RPC_US = 100.0            # full scheduling-path RPC
+_HEAD_ROUTE_US = 20.0           # origin-routed forward (no placement)
+_HEAD_TOUCH_US = 1.0            # heartbeat / lease-row liveness touch
+_HEAD_ITEM_US = 5.0             # marginal cost per batched item
 
 
 class Trace:
@@ -79,6 +95,13 @@ class SimParams:
     boot_delay_s: float = 3.0
     autoscaler_interval_s: float = 5.0
     autoscaler_idle_timeout_s: float = 60.0
+    # lease plane + hot standby (r15); both default off so pre-r15
+    # campaign trace hashes replay unchanged
+    lease_plane: bool = False
+    lease_overcommit: float = 2.0
+    lease_max_classes: int = 64
+    standby: bool = False
+    standby_quorum: float = 0.34
 
     @classmethod
     def from_config(cls) -> "SimParams":
@@ -90,12 +113,28 @@ class SimParams:
             drain_deadline_s=cfg.sim_drain_deadline_s,
             node_capacity=cfg.sim_node_capacity,
             boot_delay_s=cfg.sim_boot_delay_s,
+            lease_plane=cfg.sim_lease_plane,
+            lease_overcommit=cfg.lease_overcommit,
+            lease_max_classes=cfg.lease_max_classes,
+            standby=cfg.sim_standby,
+            standby_quorum=cfg.standby_quorum,
         )
+
+    @property
+    def fence_horizon_s(self) -> float:
+        """How long a node may go without confirmed head contact before
+        it stops granting locally — the same horizon after which the
+        head declares it dead, so self-fencing always precedes a
+        death-driven revocation."""
+        return self.heartbeat_period_s * self.miss_threshold
 
 
 class SimNode:
     """One simulated node agent: heartbeat loop, lease execution with
-    idempotent re-grant handling, ack retry, drain participation."""
+    idempotent re-grant handling, ack retry, drain participation — and,
+    with the lease plane on, a :class:`LocalLeaseCache` that admits
+    batched submissions locally, spills misses back to the head, and
+    self-fences when head contact is lost."""
 
     def __init__(self, cluster: "SimCluster", nid: str):
         self.cluster = cluster
@@ -107,13 +146,27 @@ class SimNode:
         self.registered = False
         self.draining = False
         self.running: dict[str, float] = {}     # tid -> started (virtual)
+        self.classes: dict[str, str] = {}       # tid -> class (lease path)
+        self.local_queue: deque = deque()       # (tid, duration, class)
         self.done: dict[str, str] = {}          # tid -> oid (ack cache)
+        self.done_buffer: list = []             # (tid, oid) awaiting flush
         self.holds: dict[str, bool] = {}        # oid -> True
+        self.lease: LocalLeaseCache | None = None
+        if self.params.lease_plane:
+            self.lease = LocalLeaseCache(
+                capacity=self.params.node_capacity,
+                fence_after_s=self.params.fence_horizon_s,
+                overcommit=self.params.lease_overcommit,
+                max_classes=self.params.lease_max_classes)
+        handlers = {"exec": self._h_exec, "drain": self._h_drain,
+                    "ping": self._h_ping}
+        if self.params.lease_plane:
+            handlers["submit_batch"] = self._h_submit_batch
         self.server = cluster.transport.serve(
-            {"exec": self._h_exec, "drain": self._h_drain,
-             "ping": self._h_ping}, host=self.address).start()
+            handlers, host=self.address).start()
         self.head = cluster.transport.connect(HEAD_ADDR,
                                               _sim_src=self.address)
+        self._standby = None
 
     def start(self, stagger: float = 0.0) -> None:
         self.clock.call_later(stagger, self._beat)
@@ -124,24 +177,86 @@ class SimNode:
             return
         try:
             if not self.registered:
-                self.head.call("register", self.nid, self.address,
-                               self._report())
+                reply = self.head.call("register", self.nid,
+                                       self.address, self._report())
                 self.registered = True
+                self._fold_head_reply(reply)
             else:
-                reply = self.head.call("heartbeat", self.nid)
-                if reply == "reregister":
+                payload = self._hb_payload()
+                reply = self.head.call("heartbeat", self.nid, payload)
+                if reply == "reregister" or (
+                        isinstance(reply, dict) and
+                        reply.get("op") == "reregister"):
                     # restarted head lost our row: rejoin with state
                     self.registered = False
-                    self.head.call("register", self.nid, self.address,
-                                   self._report())
+                    reply = self.head.call("register", self.nid,
+                                           self.address, self._report())
                     self.registered = True
+                self._fold_head_reply(reply)
+                if payload is not None:
+                    # the head folded the piggybacked done acks
+                    del self.done_buffer[:len(payload["done"])]
         except RpcConnectionError:
-            pass        # head down/partitioned: keep beating
+            self._vote()        # head down/partitioned: keep beating
         self.clock.call_later(self.params.heartbeat_period_s, self._beat)
 
     def _report(self) -> dict:
-        return {"running": list(self.running), "done": dict(self.done),
-                "holds": list(self.holds), "draining": self.draining}
+        report = {"running": list(self.running), "done": dict(self.done),
+                  "holds": list(self.holds), "draining": self.draining}
+        if self.lease is not None:
+            # promotion rejoin: the promoted head re-adopts our leases
+            # (grant authority stayed here) and our locally-queued work
+            report["lease_epoch"] = self.lease.epoch
+            report["lease_classes"] = self.lease.held_classes()
+            report["leased_queued"] = [tid for tid, _d, _c
+                                       in self.local_queue]
+        return report
+
+    def _hb_payload(self) -> dict | None:
+        if self.lease is None:
+            return None
+        return {"done": list(self.done_buffer),
+                "leased": list(self.running) +
+                [tid for tid, _d, _c in self.local_queue]}
+
+    def _fold_head_reply(self, reply) -> None:
+        """Confirmed head contact: refresh the fence clock and fold the
+        lease epoch + any fresh grants the head piggybacked."""
+        if self.lease is None:
+            return
+        now = self.clock.monotonic()
+        self.lease.on_head_contact(now)
+        if not isinstance(reply, dict):
+            return
+        if self.lease.observe_epoch(reply.get("epoch", 0)):
+            self._discard_queue("epoch_revoked")
+        grants = reply.get("grants")
+        if grants:
+            self.lease.install(grants, reply.get("epoch", 0))
+
+    def _discard_queue(self, reason: str) -> None:
+        """The head revoked our epoch: it already requeued everything
+        we had locally admitted but not started — drop it, never start
+        a task under a dead epoch."""
+        if self.local_queue:
+            self.cluster.trace.rec(
+                self.clock.monotonic(), "lease_queue_discard",
+                node=self.nid, dropped=len(self.local_queue),
+                reason=reason)
+            self.local_queue.clear()
+
+    def _vote(self) -> None:
+        """Head unreachable: vote for standby promotion (quorum is the
+        standby's promotion gate; a partitioned minority never wins)."""
+        if not self.params.standby:
+            return
+        if self._standby is None:
+            self._standby = self.cluster.transport.connect(
+                STANDBY_ADDR, _sim_src=self.address)
+        try:
+            self._standby.call("vote", self.nid)
+        except RpcConnectionError:
+            pass
 
     # -- handlers ------------------------------------------------------------
     def _h_ping(self) -> str:
@@ -155,12 +270,62 @@ class SimNode:
             return {"op": "running"}        # dup delivery: idempotent
         if self.draining:
             return {"op": "rejected"}
-        self.running[tid] = self.clock.monotonic()
-        self.clock.call_later(duration, lambda: self._complete(tid))
+        self._start(tid, duration, epoch=-1)
         return {"op": "accepted"}
+
+    def _h_submit_batch(self, tasks: list, epoch: int, grants: dict):
+        """One framed multi-submit from the head's origin routing:
+        admit locally against the leased budgets, spill the rest.
+        ``tasks`` is ``[(tid, duration, class_key), ...]``."""
+        lease = self.lease
+        now = self.clock.monotonic()
+        if self.lease.observe_epoch(epoch):
+            self._discard_queue("epoch_revoked")
+        lease.install(grants, epoch)
+        lease.on_head_contact(now)      # the head just reached us
+        accepted, spilled = [], []
+        for tid, duration, class_key in tasks:
+            if tid in self.done:
+                accepted.append(tid)    # idempotent re-submit
+                continue
+            if tid in self.running or any(t == tid for t, _d, _c
+                                          in self.local_queue):
+                accepted.append(tid)
+                continue
+            if self.draining or not lease.try_grant(class_key, now):
+                spilled.append(tid)
+                continue
+            self.classes[tid] = class_key
+            self.local_queue.append((tid, duration, class_key))
+            accepted.append(tid)
+        self.cluster.leasing["local_grants"] += len(accepted)
+        self.cluster.leasing["spillbacks"] += len(spilled)
+        self._pump_local()
+        return {"accepted": accepted, "spilled": spilled}
+
+    def _pump_local(self) -> None:
+        """Start locally-admitted tasks while run slots are free — but
+        never while fenced (head contact lost past the horizon: our
+        epoch may already be revoked)."""
+        now = self.clock.monotonic()
+        while self.local_queue and \
+                len(self.running) < self.params.node_capacity:
+            if self.lease.fenced(now):
+                return      # resume after the next confirmed contact
+            tid, duration, _class_key = self.local_queue.popleft()
+            self._start(tid, duration, epoch=self.lease.epoch)
+
+    def _start(self, tid: str, duration: float, epoch: int) -> None:
+        self.running[tid] = self.clock.monotonic()
+        if self.params.lease_plane:
+            # the no-double-execution invariant audits this log
+            self.cluster.exec_log.append(
+                (tid, self.nid, epoch, self.clock.monotonic()))
+        self.clock.call_later(duration, lambda: self._complete(tid))
 
     def _h_drain(self) -> str:
         self.draining = True
+        self._discard_queue("drain")
         if not self.running:
             self._drain_done(0)
         return "ok"
@@ -175,22 +340,49 @@ class SimNode:
         if len(self.done) > 512:            # bounded idempotency window
             self.done.pop(next(iter(self.done)))
         self.holds[oid] = True
-        self._ack(tid, oid, 0)
-        if self.draining and not self.running:
+        class_key = self.classes.pop(tid, None)
+        if class_key is not None and self.lease is not None:
+            self.lease.release(class_key)
+        if self.lease is not None:
+            # batched ack: piggybacks on the next heartbeat, with an
+            # early flush so a hot node's tail never waits a period
+            self.done_buffer.append((tid, oid))
+            if len(self.done_buffer) >= 32:
+                self._flush_done()
+            self._pump_local()
+        else:
+            self._ack(tid, oid, 0)
+        if self.draining and not self.running and not self.local_queue:
             self._drain_done(0)
+
+    def _flush_done(self) -> None:
+        if not self.done_buffer:
+            return
+        batch = list(self.done_buffer)
+        try:
+            self.head.call("task_done_batch", self.nid, batch)
+            del self.done_buffer[:len(batch)]
+            self.lease.on_head_contact(self.clock.monotonic())
+        except RpcConnectionError:
+            self._vote()    # heartbeat retry still holds the buffer
 
     def _ack(self, tid: str, oid: str, attempt: int) -> None:
         if not self.alive:
             return
         try:
             self.head.call("task_done", self.nid, tid, oid)
+            if self.lease is not None:
+                self.lease.on_head_contact(self.clock.monotonic())
         except RpcConnectionError:
+            self._vote()
             self.clock.call_later(min(8.0, 1.0 + attempt),
                                   lambda: self._ack(tid, oid, attempt + 1))
 
     def _drain_done(self, attempt: int) -> None:
         if not self.alive or not self.draining or self.running:
             return
+        if self.lease is not None:
+            self._flush_done()      # never strand buffered acks at exit
         try:
             self.head.call("drain_done", self.nid)
         except RpcConnectionError:
@@ -225,18 +417,63 @@ class SimHead:
         self.pending: deque[str] = deque()
         self.breakers: dict[str, PeerBreaker] = {}
         self._clients: dict[str, object] = {}
+        self.grantor: LeaseGrantor | None = None
+        if self.params.lease_plane:
+            # revocation epochs journal into the persist dict, so the
+            # promoted head never re-issues a revoked epoch
+            journal = self.persist.setdefault("lease_epochs", {})
+
+            def _journal(node: str, epoch: int) -> None:
+                journal[node] = epoch
+
+            # per-class budgets cover the node's full overcommit bound:
+            # a single-class wave (the common repeat-class shape) can
+            # fill a node without artificial per-class throttling — the
+            # raylet's admitted_total cap enforces the real limit
+            self.grantor = LeaseGrantor(
+                budget_per_class=int(self.params.node_capacity *
+                                     self.params.lease_overcommit),
+                max_classes=self.params.lease_max_classes,
+                journal=_journal)
+        handlers = {
+            "register": self._h_register, "heartbeat": self._h_heartbeat,
+            "job_submit": self._h_job_submit, "task_done": self._h_task_done,
+            "drain_done": self._h_drain_done, "ping": self._h_ping,
+            "status": self._h_status}
+        if self.params.lease_plane:
+            handlers["spillback"] = self._h_spillback
+            handlers["task_done_batch"] = self._h_task_done_batch
         self.server = cluster.transport.serve(
-            {"register": self._h_register, "heartbeat": self._h_heartbeat,
-             "job_submit": self._h_job_submit, "task_done": self._h_task_done,
-             "drain_done": self._h_drain_done, "ping": self._h_ping,
-             "status": self._h_status}, host=HEAD_ADDR).start()
+            handlers, host=HEAD_ADDR).start()
         self._restore()
         self.clock.call_later(self.params.heartbeat_period_s,
                               self._monitor)
 
+    def _busy(self, us: float, dispatch: bool = True) -> None:
+        """Accrue modeled head service time.  ``dispatch=False`` marks
+        pure liveness work (heartbeat row touches) — identical in both
+        dispatch modes, so the throughput denominator excludes it and
+        the comparison measures what the lease plane actually moves."""
+        self.cluster.head_busy_us += us
+        if dispatch:
+            self.cluster.head_dispatch_us += us
+
+    def _note_dispatch(self) -> None:
+        """First dispatch after a head kill closes the failover window."""
+        cl = self.cluster
+        if cl.last_head_kill_t is not None:
+            ms = round((self.clock.monotonic() - cl.last_head_kill_t)
+                       * 1000.0, 3)
+            cl.failover_ms.append(ms)
+            cl.last_head_kill_t = None
+            self.trace.rec(self.clock.monotonic(),
+                           "failover_first_dispatch", ms=ms)
+
     # -- persistence ---------------------------------------------------------
     def _restore(self) -> None:
         restored = 0
+        if self.grantor is not None:
+            self.grantor.restore(self.persist.get("lease_epochs", {}))
         for jid, spec in self.persist["jobs"].items():
             tids = list(spec["tasks"])
             self.jobs[jid] = {"tasks": tids, "status": "running"}
@@ -263,13 +500,16 @@ class SimHead:
     def _h_ping(self) -> str:
         return "pong"
 
-    def _h_register(self, nid: str, address: str, report: dict) -> str:
+    def _h_register(self, nid: str, address: str, report: dict):
+        # membership bootstrap, not dispatch: identical in both
+        # dispatch modes, so it stays out of the throughput denominator
+        self._busy(_HEAD_RPC_US, dispatch=False)
         now = self.clock.monotonic()
         known = nid in self.nodes
         self.nodes[nid] = {
             "address": address, "state": ALIVE, "last_hb": now,
             "suspect": False, "running": {}, "drain_started": None,
-            "idle_since": now,
+            "idle_since": now, "leased": {},
         }
         if not known:
             self._node_order.append(nid)
@@ -290,23 +530,75 @@ class SimHead:
                 t["node"] = nid
                 t["granted_at"] = now
                 row["running"][tid] = True
+        if self.grantor is not None:
+            # promotion rejoin: grant authority stayed at the raylet —
+            # re-adopt its lease set when its epoch is still current
+            # (the journal survived the kill), else force a discard
+            epoch = self.grantor.epoch(nid)
+            if report.get("lease_epoch", 0) == epoch:
+                for class_key in report.get("lease_classes", ()):
+                    self.grantor.grant(nid, class_key)
+                for tid in report.get("leased_queued", ()):
+                    t = self.tasks.get(tid)
+                    if t is not None and t["state"] in ("pending",
+                                                       "leased"):
+                        if t["state"] == "pending":
+                            try:
+                                self.pending.remove(tid)
+                            except ValueError:
+                                pass
+                        t["state"] = "leased"
+                        t["node"] = nid
+                        t["granted_at"] = now
+                        row["leased"][tid] = now
+            self._schedule()
+            epoch, grants = self.grantor.snapshot_for(nid)
+            return {"op": "ok", "epoch": epoch, "grants": grants}
         self._schedule()
         return "ok"
 
-    def _h_heartbeat(self, nid: str) -> str:
+    def _h_heartbeat(self, nid: str, payload: dict | None = None):
+        self._busy(_HEAD_TOUCH_US, dispatch=False)
         row = self.nodes.get(nid)
         if row is None or row["state"] in (DEAD, REMOVED):
+            if self.grantor is not None:
+                return {"op": "reregister"}
             return "reregister"
-        row["last_hb"] = self.clock.monotonic()
+        now = self.clock.monotonic()
+        row["last_hb"] = now
         # serve-plane piggyback: the load digest for this node's replica
         # folds on the heartbeat that carries its liveness — the same
         # no-extra-RPC contract as the live gossip board
         plane = self.cluster.serve_plane
         if plane is not None:
             plane.on_heartbeat(nid)
-        return "ok"
+        if self.grantor is None:
+            return "ok"
+        if payload is not None:
+            self._busy(_HEAD_ITEM_US * len(payload.get("done", ())))
+            self._busy(_HEAD_TOUCH_US * len(payload.get("leased", ())),
+                       dispatch=False)
+            for tid, oid in payload.get("done", ()):
+                self._mark_done(tid, oid, nid)
+            # a reported leased task is alive at its raylet: refresh it
+            # so the TTL sweep only revokes genuinely quiet grants
+            for tid in payload.get("leased", ()):
+                if tid in row["leased"]:
+                    row["leased"][tid] = now
+            if payload.get("done"):
+                self._schedule()
+        return {"op": "ok", "epoch": self.grantor.epoch(nid),
+                "grants": None}
+
+    def _class_key(self, duration: float) -> str:
+        """Scheduling class of a simulated task.  Durations stand in
+        for the interned resource-request vector: tasks of one class
+        are shaped alike, which is exactly what makes repeat
+        submissions lease-servable."""
+        return f"c{duration:g}"
 
     def _h_job_submit(self, jid: str, tasks: dict) -> str:
+        self._busy(_HEAD_RPC_US + _HEAD_ITEM_US * len(tasks))
         if jid not in self.persist["jobs"]:
             # persist BEFORE acking: an acked job survives a head kill
             self.persist["jobs"][jid] = {"tasks": dict(tasks)}
@@ -323,9 +615,41 @@ class SimHead:
         return "ack"
 
     def _h_task_done(self, nid: str, tid: str, oid: str) -> str:
+        self._busy(_HEAD_RPC_US)
         self._mark_done(tid, oid, nid)
         self._schedule()
         return "ok"
+
+    def _h_task_done_batch(self, nid: str, items: list) -> str:
+        self._busy(_HEAD_RPC_US + _HEAD_ITEM_US * len(items))
+        for tid, oid in items:
+            self._mark_done(tid, oid, nid)
+        self._schedule()
+        return "ok"
+
+    def _h_spillback(self, nid: str, tids: list) -> str:
+        """A raylet handed leased tasks back (budget exhausted, fenced,
+        or stale epoch): the head reschedules them globally."""
+        self._busy(_HEAD_RPC_US + _HEAD_ITEM_US * len(tids))
+        self._repend(nid, tids)
+        self._schedule()
+        return "ok"
+
+    def _repend(self, nid: str, tids) -> int:
+        row = self.nodes.get(nid)
+        n = 0
+        for tid in tids:
+            t = self.tasks.get(tid)
+            if t is None or t["state"] not in ("leased", "running"):
+                continue
+            t["state"] = "pending"
+            t["node"] = None
+            self.pending.append(tid)
+            n += 1
+            if row is not None:
+                row["leased"].pop(tid, None)
+                row["running"].pop(tid, None)
+        return n
 
     def _h_drain_done(self, nid: str) -> str:
         row = self.nodes.get(nid)
@@ -352,11 +676,13 @@ class SimHead:
             prow = self.nodes.get(prev)
             if prow is not None:
                 prow["running"].pop(tid, None)
+                prow["leased"].pop(tid, None)
                 if not prow["running"]:
                     prow["idle_since"] = self.clock.monotonic()
         nrow = self.nodes.get(nid)
         if nrow is not None:
             nrow["running"].pop(tid, None)
+            nrow["leased"].pop(tid, None)
             if not nrow["running"]:
                 nrow["idle_since"] = self.clock.monotonic()
         obj = self.objects.setdefault(oid,
@@ -424,7 +750,8 @@ class SimHead:
                     continue    # serve replica or LOANED: off the market
                 if row["suspect"] and not allow_suspect:
                     continue
-                if len(row["running"]) >= self.params.node_capacity:
+                if len(row["running"]) + len(row["leased"]) >= \
+                        self.params.node_capacity:
                     continue
                 if row["suspect"] and \
                         not self._breaker(row["address"]).allow():
@@ -435,6 +762,9 @@ class SimHead:
 
     def _schedule(self) -> None:
         if not self.alive:
+            return
+        if self.grantor is not None:
+            self._schedule_lease()
             return
         for _ in range(len(self.pending)):
             if not self.pending:
@@ -449,7 +779,120 @@ class SimHead:
                 break
             self._grant(tid, nid)
 
+    # -- lease-plane dispatch ------------------------------------------------
+    def _lease_headroom(self, nid: str) -> int:
+        """How many more tasks the head will route at ``nid`` — mirrors
+        the raylet's own overcommit admission bound, so routed batches
+        rarely spill."""
+        row = self.nodes.get(nid)
+        if row is None or row["state"] != ALIVE or row["suspect"]:
+            return 0
+        plane = self.cluster.serve_plane
+        if plane is not None and nid in plane.reserved:
+            return 0
+        cap = int(self.params.node_capacity *
+                  self.params.lease_overcommit)
+        return cap - len(row["running"]) - len(row["leased"])
+
+    def _lease_class_headroom(self, nid: str, class_key: str) -> int:
+        """Headroom for one class at one node: the overall overcommit
+        bound AND the per-class budget the raylet enforces.  Mirroring
+        both means routed batches are admitted, not spilled — the
+        head's view of in-flight leases only ever lags toward fewer
+        routes, never more."""
+        room = self._lease_headroom(nid)
+        if room <= 0:
+            return 0
+        row = self.nodes[nid]
+        inflight = 0
+        for tid in row["leased"]:
+            t = self.tasks.get(tid)
+            if t is not None and \
+                    self._class_key(t["duration"]) == class_key:
+                inflight += 1
+        return min(room, self.grantor.budget_per_class - inflight)
+
+    def _schedule_lease(self) -> None:
+        """Origin routing: group pending tasks by scheduling class and
+        send each group to a node already holding that class's lease
+        (one framed multi-submit per origin).  First-of-class falls back
+        to global placement and the grant rides the same batch, so the
+        admission itself is a local grant at the raylet."""
+        by_class: dict[str, list[str]] = {}
+        order: list[str] = []
+        for _ in range(len(self.pending)):
+            tid = self.pending.popleft()
+            t = self.tasks.get(tid)
+            if t is None or t["state"] != "pending":
+                continue
+            ck = self._class_key(t["duration"])
+            if ck not in by_class:
+                by_class[ck] = []
+                order.append(ck)
+            by_class[ck].append(tid)
+        for ck in order:
+            tids = by_class[ck]
+            while tids:
+                origin = self.grantor.origin_for(
+                    ck, eligible=lambda nid:
+                    self._lease_class_headroom(nid, ck) > 0)
+                if origin is None:
+                    origin = self._pick_node()
+                    if origin is None:
+                        # no capacity anywhere: back on the queue
+                        for tid in tids:
+                            self.pending.append(tid)
+                        break
+                    self.grantor.grant(origin, ck)
+                tids = self._submit_batch(origin, ck, tids)
+
+    def _submit_batch(self, nid: str, class_key: str,
+                      tids: list) -> list:
+        """One multi-submit to ``nid`` covering its headroom; returns
+        the tids still to place (the rest of the class group)."""
+        row = self.nodes[nid]
+        take = min(len(tids),
+                   max(1, self._lease_class_headroom(nid, class_key)))
+        batch_tids, rest = tids[:take], tids[take:]
+        batch = [(tid, self.tasks[tid]["duration"], class_key)
+                 for tid in batch_tids]
+        epoch, grants = self.grantor.snapshot_for(nid)
+        b = self._breaker(row["address"])
+        self._busy(_HEAD_ROUTE_US + _HEAD_ITEM_US * len(batch))
+        try:
+            reply = self._client(nid).call("submit_batch", batch,
+                                           epoch, grants)
+        except RpcConnectionError:
+            b.record_failure()
+            self._after_breaker(nid, b)
+            for tid in batch_tids:
+                self.pending.append(tid)
+            return rest
+        b.record_success()
+        self._after_breaker(nid, b)
+        now = self.clock.monotonic()
+        accepted = set(reply.get("accepted", ()))
+        for tid in batch_tids:
+            t = self.tasks.get(tid)
+            if t is None or t["state"] != "pending":
+                continue
+            if tid in accepted:
+                t["state"] = "leased"
+                t["node"] = nid
+                t["granted_at"] = now
+                t["attempts"] += 1
+                row["leased"][tid] = now
+            else:
+                # spillback: the raylet refused (budget, fence, drain);
+                # the head stays the single source of truth and will
+                # re-route on the next scheduling pass
+                self.pending.append(tid)
+        if accepted:
+            self._note_dispatch()
+        return rest
+
     def _grant(self, tid: str, nid: str) -> None:
+        self._busy(_HEAD_RPC_US)
         row = self.nodes[nid]
         b = self._breaker(row["address"])
         t = self.tasks[tid]
@@ -473,6 +916,7 @@ class SimHead:
         t["granted_at"] = self.clock.monotonic()
         t["attempts"] += 1
         row["running"][tid] = True
+        self._note_dispatch()
 
     # -- drain / death / removal ---------------------------------------------
     def start_drain(self, nid: str, reason: str) -> bool:
@@ -493,6 +937,7 @@ class SimHead:
         row = self.nodes[nid]
         row["state"] = DEAD
         requeued = self._requeue_node(nid)
+        self._revoke_node(nid, reason)
         for oid in list(self.objects):
             self.objects[oid]["copies"].pop(nid, None)
         self.trace.rec(self.clock.monotonic(), "node_dead", node=nid,
@@ -511,12 +956,35 @@ class SimHead:
                 self.pending.append(tid)
                 requeued += 1
         row["running"].clear()
+        for tid in list(row["leased"]):
+            t = self.tasks.get(tid)
+            if t is not None and t["state"] == "leased" and \
+                    t["node"] == nid:
+                t["state"] = "pending"
+                t["node"] = None
+                self.pending.append(tid)
+                requeued += 1
+        row["leased"].clear()
         return requeued
+
+    def _revoke_node(self, nid: str, reason: str) -> None:
+        """Bump the node's lease epoch (journaled) and forget its grant
+        set: any grant it stamped below the new epoch is dead."""
+        if self.grantor is None:
+            return
+        epoch = self.grantor.drop_node(nid, reason)
+        now = self.clock.monotonic()
+        self.cluster.leasing["revocations"] += 1
+        self.cluster.revocation_log.setdefault(nid, []).append(
+            (epoch, now))
+        self.trace.rec(now, "lease_revoked", node=nid, epoch=epoch,
+                       reason=reason)
 
     def _remove_node(self, nid: str, reason: str) -> None:
         row = self.nodes[nid]
         if row["state"] != DEAD:
             self._requeue_node(nid)
+            self._revoke_node(nid, reason)
         row["state"] = REMOVED
         row["drain_started"] = None
         self.trace.rec(self.clock.monotonic(), "node_removed", node=nid,
@@ -555,6 +1023,23 @@ class SimHead:
                     self.pending.append(tid)
                     self.trace.rec(now, "lease_requeued", task=tid,
                                    node=nid)
+            # quiet-lease TTL sweep: a grant the raylet stopped
+            # reporting went quiet past the TTL — revoke the node's
+            # whole epoch (the raylet's queue dies with it) and requeue
+            # everything it was leased, so nothing starts twice without
+            # the epoch fence on record
+            if self.grantor is not None and row["leased"]:
+                quiet = any(now - last > p.lease_timeout_s
+                            for last in row["leased"].values())
+                if quiet:
+                    epoch = self.grantor.revoke(nid, "quiet_lease")
+                    self.cluster.leasing["revocations"] += 1
+                    self.cluster.revocation_log.setdefault(
+                        nid, []).append((epoch, now))
+                    requeued = self._repend(nid, list(row["leased"]))
+                    self.trace.rec(now, "lease_revoked", node=nid,
+                                   epoch=epoch, reason="quiet_lease",
+                                   requeued=requeued)
             # half-open probes for quarantined nodes
             if row["state"] == ALIVE and row["suspect"]:
                 b = self._breaker(row["address"])
@@ -582,6 +1067,84 @@ class SimHead:
                                        job=jid)
         self._schedule()
         self.clock.call_later(p.heartbeat_period_s, self._monitor)
+
+
+class SimStandby:
+    """Hot-standby head.  A follower that tails the shared persist dict
+    (job table, done acks and the lease-epoch journal all live there),
+    probes the primary at a quarter-heartbeat cadence, and collects
+    raylet votes: every node that fails an RPC to the head votes here.
+
+    Promotion is double-gated — the standby must have missed >= 2 of
+    its own probes AND hold votes from a quorum fraction of live nodes.
+    Under an asymmetric partition that cuts only the standby<->head
+    link, the nodes keep reaching the head, never vote, and the lone
+    standby can't split-brain; under a real head death both gates open
+    within half a heartbeat period and the standby promotes by calling
+    ``cluster.start_head()`` — which restores jobs, done acks and the
+    revocation-epoch journal, so outstanding leases survive."""
+
+    def __init__(self, cluster: "SimCluster"):
+        self.cluster = cluster
+        self.clock = cluster.clock
+        self.params = cluster.params
+        self.alive = True
+        self.votes: set[str] = set()        # counted, never iterated
+        self.probe_failures = 0
+        self.server = cluster.transport.serve(
+            {"vote": self._h_vote, "ping": self._h_ping},
+            host=STANDBY_ADDR).start()
+        self._head = cluster.transport.connect(HEAD_ADDR,
+                                               _sim_src=STANDBY_ADDR)
+        self.clock.call_later(self._probe_interval, self._probe)
+
+    @property
+    def _probe_interval(self) -> float:
+        return self.params.heartbeat_period_s / 4.0
+
+    def _h_ping(self) -> str:
+        return "pong"
+
+    def _h_vote(self, nid: str) -> str:
+        self.votes.add(nid)
+        self._maybe_promote()
+        return "ok"
+
+    def _probe(self) -> None:
+        if not self.alive or not self.cluster.running:
+            return
+        try:
+            self._head.call("ping")
+            # primary reachable from here: clear stale votes so a past
+            # blip can never combine with a later one into a quorum
+            self.probe_failures = 0
+            self.votes.clear()
+        except RpcConnectionError:
+            self.probe_failures += 1
+            self._maybe_promote()
+        self.clock.call_later(self._probe_interval, self._probe)
+
+    def _maybe_promote(self) -> None:
+        if not self.alive or self.probe_failures < 2:
+            return
+        need = max(1, -(-int(self.params.standby_quorum * 1000 *
+                             self.cluster.alive_count) // 1000))
+        if len(self.votes) < need:
+            return
+        self._promote()
+
+    def _promote(self) -> None:
+        cl = self.cluster
+        if cl.head is not None and cl.head.alive:
+            return      # primary is actually alive: never split-brain
+        self.alive = False
+        cl.transport.kill(STANDBY_ADDR)
+        cl.trace.rec(self.clock.monotonic(), "standby_promote",
+                     votes=len(self.votes),
+                     probe_failures=self.probe_failures)
+        cl.promotions += 1
+        cl.start_head()     # restores persist incl. the epoch journal
+        cl.standby = SimStandby(cl)     # a fresh follower takes over
 
 
 class SimAutoscaler:
@@ -672,7 +1235,22 @@ class SimCluster:
         self.head: SimHead | None = None
         self.autoscaler: SimAutoscaler | None = None
         self.serve_plane = None     # installed by serve_diurnal campaigns
+        # lease plane + failover bookkeeping (cluster-scoped so it
+        # survives head kills; the promoted head keeps accruing)
+        self.head_busy_us = 0.0
+        self.head_dispatch_us = 0.0     # busy minus liveness touches
+        self.leasing = {"local_grants": 0, "spillbacks": 0,
+                        "revocations": 0}
+        self.exec_log: list = []        # (tid, nid, epoch, start_t)
+        self.exec_audited = 0           # starts already invariant-checked
+        self.revocation_log: dict[str, list] = {}   # nid -> [(epoch, t)]
+        self.failover_ms: list[float] = []
+        self.last_head_kill_t: float | None = None
+        self.promotions = 0
+        self.standby: SimStandby | None = None
         self.start_head()
+        if self.params.standby:
+            self.standby = SimStandby(self)
         period = self.params.heartbeat_period_s
         for i in range(num_nodes):
             # stagger first beats across one period so 10k registrations
@@ -713,6 +1291,9 @@ class SimCluster:
             self.head.alive = False
             self.transport.kill(HEAD_ADDR)
             self.head = None
+            # failover window opens: closed by the first dispatch of
+            # whichever head comes back (restart or standby promotion)
+            self.last_head_kill_t = self.clock.monotonic()
 
     def launch_node(self, stagger: float | None = None,
                     booting: bool = False) -> str:
@@ -758,7 +1339,10 @@ class SimCluster:
 
     def stats(self) -> dict:
         tr = self.transport
-        return {
+        busy_s = self.head_busy_us / 1e6
+        disp_s = self.head_dispatch_us / 1e6
+        done = len(self.persist["done"])
+        s = {
             "virtual_s": round(self.clock.monotonic(), 3),
             "events_fired": self.clock.fired,
             "rpc_calls": tr.calls,
@@ -769,4 +1353,30 @@ class SimCluster:
             "chaos_delayed": self.chaos.num_delayed,
             "peak_nodes": self.peak_nodes,
             "trace_events": self.trace.total,
+            # dispatch throughput over modeled head service time
+            # attributable to dispatching (liveness touches excluded —
+            # they are identical in both modes): the lease-vs-head-only
+            # comparison the bench records
+            "dispatch": {
+                "tasks_done": done,
+                "head_busy_s": round(busy_s, 6),
+                "head_dispatch_s": round(disp_s, 6),
+                "throughput_per_s": round(done / disp_s, 3)
+                if disp_s else 0.0,
+            },
         }
+        if self.params.lease_plane:
+            hits = self.leasing["local_grants"]
+            miss = self.leasing["spillbacks"]
+            s["leasing"] = {
+                "leases_granted_local": hits,
+                "spillbacks": miss,
+                "lease_hit_rate": round(hits / (hits + miss), 4)
+                if hits + miss else 0.0,
+                "lease_revocations": self.leasing["revocations"],
+                "lease_starts": self.exec_audited + len(self.exec_log),
+                "promotions": self.promotions,
+                "failover_ms": [round(ms, 3)
+                                for ms in self.failover_ms],
+            }
+        return s

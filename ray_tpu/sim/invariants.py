@@ -22,11 +22,50 @@ The five invariants, and the machinery each one proves:
    ``serve_diurnal`` campaign installed a ``SimServePlane``: every
    accepted request is accounted for in some queue (strictly:
    completed), and capacity loans converge to reclaimed-or-booked-lost
+7. **no double-executed lease after epoch revocation** — lease plane
+   (r15): once the head revokes a node's epoch, no task may *start*
+   on that node under the revoked epoch past the grace window.  The
+   raylet self-fences at the same horizon the head uses to declare it
+   dead, so every start in ``cluster.exec_log`` must carry an epoch
+   that is current for its node — or predate the revocation + grace.
+   Invariant 1 doubles as the failover check: acked jobs must survive
+   a standby promotion, because promotion is just ``start_head()``
+   over the same persisted tables.
 """
 
 from __future__ import annotations
 
 __all__ = ["check_invariants"]
+
+
+def _check_exec_log(cluster, grace: float) -> tuple[list[str], int]:
+    """Scan lease-plane starts against the revocation log.  Incremental:
+    starts already audited are dropped, so a 10k-node campaign pays for
+    each start once.  A start under epoch ``e`` on node ``n`` violates
+    iff some revocation ``(e_r, t_r)`` of ``n`` has ``e_r > e`` and the
+    start happened after ``t_r + grace`` (inside the window the
+    recovery machinery is still allowed to race)."""
+    violations: list[str] = []
+    log = cluster.exec_log
+    checks = len(log)
+    for tid, nid, epoch, t_start in log:
+        if epoch < 0:
+            continue        # non-lease exec path start: out of scope
+        revs = cluster.revocation_log.get(nid)
+        if not revs:
+            continue
+        for e_r, t_r in revs:
+            if e_r > epoch and t_start > t_r + grace:
+                violations.append(
+                    f"double-executed lease: {tid} started on "
+                    f"{nid} at t={t_start:.3f} under epoch "
+                    f"{epoch}, revoked to {e_r} at t={t_r:.3f}")
+                break
+    # a start can never become violating later (a future revocation's
+    # t_r is >= now > t_start): audited entries are done for good
+    cluster.exec_audited += checks
+    del log[:]
+    return violations, checks
 
 
 def check_invariants(cluster, acked_jobs, strict: bool = False
@@ -64,6 +103,14 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
                     violations.append(
                         f"lease stuck: {tid} on {nid} for "
                         f"{now - t['granted_at']:.1f}s")
+            # lease-plane form: a locally-admitted grant the raylet
+            # stopped reporting must be revoked+requeued by the sweep
+            for tid, last in row["leased"].items():
+                checks += 1
+                if now - last > p.lease_timeout_s + grace:
+                    violations.append(
+                        f"leased task stuck: {tid} on {nid} quiet "
+                        f"for {now - last:.1f}s")
             # 3. drains converge (deadline force-removal backstop)
             if row["state"] == "draining":
                 checks += 1
@@ -108,6 +155,14 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
     plane = getattr(cluster, "serve_plane", None)
     if plane is not None and plane.started:
         v, n = plane.check(strict=strict, now=now, grace=grace)
+        violations.extend(v)
+        checks += n
+
+    # 7. no double-executed lease after epoch revocation (lease plane);
+    # head-independent: the logs live on the cluster, so this audits
+    # through head-down windows and across standby promotions
+    if cluster.params.lease_plane:
+        v, n = _check_exec_log(cluster, grace)
         violations.extend(v)
         checks += n
 

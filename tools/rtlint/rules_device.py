@@ -36,7 +36,7 @@ import re
 
 from .finding import Finding
 
-_SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/")
+_SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/", "ray_tpu/leasing/")
 _EXTRA_FILES = ("ray_tpu/runtime/raylet.py",)
 _NP_COERCIONS = ("asarray", "array")
 

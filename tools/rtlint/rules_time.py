@@ -39,8 +39,10 @@ from .finding import Finding
 
 _CLOCK_FNS = ("time", "monotonic", "sleep")
 _SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/",
-           "ray_tpu/serve/gossip.py", "ray_tpu/serve/loaning.py")
-_TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/")
+           "ray_tpu/leasing/", "ray_tpu/serve/gossip.py",
+           "ray_tpu/serve/loaning.py")
+_TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/",
+                    "ray_tpu/leasing/")
 _EXEMPT = ("ray_tpu/common/clock.py", "ray_tpu/rpc/transport.py")
 
 
