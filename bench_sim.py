@@ -12,6 +12,13 @@ Record shape (``SIM_r0X.json``): exactly one JSON line with the usual
 is events/sec against a 50k-events/sec bar — comfortably more control
 traffic than a real 1k-node cluster generates, simulated faster than
 real time by orders of magnitude.
+
+A second stage benchmarks the adversarial hunt (``sim/hunt.py``): a
+fixed-seed canary campaign search, reporting search throughput
+(runs/sec), coverage keys reached, time-to-find the planted bug (runs
+and wall seconds), and the minimized reproduction size.  The hunt
+itself never reads the wall clock (it must be a pure function of its
+Philox seed), so timing happens out here.
 """
 
 import json
@@ -23,6 +30,48 @@ DURATION = 400.0
 SEED = 9
 BASELINE_EVENTS_PER_SEC = 50_000.0
 WALL_BUDGET_S = 300.0           # acceptance: 10k nodes under 5 min
+
+# hunt-stage shape: the same fixed canary arguments the nightly smoke
+# pins (tests/test_hunt.py) — seed 3 finds the planted bug in ~a dozen
+# runs, leaving budget to exercise the coverage-guided mutation loop
+HUNT_BUDGET = 40
+HUNT_KW = dict(nodes=24, seed=3, faults=40, duration=200.0,
+               campaigns=("mixed", "partitions"))
+
+
+def bench_hunt():
+    from dataclasses import replace
+
+    from ray_tpu.sim.cluster import SimParams
+    from ray_tpu.sim.hunt import hunt
+
+    params = replace(SimParams.from_config(), canary=True)
+    t0 = time.perf_counter()
+    r = hunt(budget=HUNT_BUDGET, params=params, minimize=True, **HUNT_KW)
+    wall = time.perf_counter() - t0
+    canary = next((f for f in r.findings
+                   if f.signature == ("job-incomplete",)), None)
+    out = {
+        "runs": r.runs, "budget": r.budget,
+        "wall_s": round(wall, 2),
+        "runs_per_sec": round(r.runs / max(wall, 1e-9), 1),
+        "coverage_keys": r.coverage,
+        "corpus": r.corpus,
+        "new_cov_runs": r.new_cov_runs,
+        "findings": [list(f.signature) for f in r.findings],
+        "canary_found": canary is not None,
+    }
+    if canary is not None:
+        out.update({
+            "time_to_find_runs": canary.found_after_runs,
+            # wall-clock estimate: the search rate is uniform per run
+            "time_to_find_s": round(
+                wall * canary.found_after_runs / max(r.runs, 1), 2),
+            "fault_ops": len(canary.genome.ops),
+            "minimized_ops": len(canary.minimized.ops),
+            "ddmin_probes": canary.ddmin_probes,
+        })
+    return out
 
 
 def main():
@@ -57,6 +106,8 @@ def main():
                           faults=FAULTS, duration=DURATION)
         replay_ok = r2.trace_hash == r.trace_hash
 
+    hunt_detail = bench_hunt()
+
     eps = detail[-1]["events_per_sec"] if detail else 0
     for d in detail:            # headline throughput = best green scale
         if d["ok"]:
@@ -67,10 +118,17 @@ def main():
         flags += " [SCALE INCOMPLETE]"
     if not replay_ok:
         flags += " [REPLAY MISMATCH]"
+    if not hunt_detail["canary_found"]:
+        flags += " [CANARY NOT FOUND]"
     print(json.dumps({
         "metric": f"sim campaign throughput: {max_nodes} nodes, "
                   f"{FAULTS}+ faults, {checks} invariant checks, "
-                  f"replay={'ok' if replay_ok else 'FAIL'}" + flags,
+                  f"replay={'ok' if replay_ok else 'FAIL'}; hunt "
+                  f"{hunt_detail['runs_per_sec']} runs/s, "
+                  f"{hunt_detail['coverage_keys']} cov keys, canary in "
+                  f"{hunt_detail.get('time_to_find_runs', -1)} runs, "
+                  f"minimized {hunt_detail.get('fault_ops', 0)}->"
+                  f"{hunt_detail.get('minimized_ops', 0)} ops" + flags,
         "value": eps,
         "unit": "events/s",
         "vs_baseline": round(eps / BASELINE_EVENTS_PER_SEC, 2),
@@ -78,8 +136,10 @@ def main():
         "invariant_checks": checks,
         "replay_ok": replay_ok,
         "scales": detail,
+        "hunt": hunt_detail,
     }))
-    return 0 if max_nodes == SCALES[-1] and replay_ok else 1
+    return 0 if (max_nodes == SCALES[-1] and replay_ok
+                 and hunt_detail["canary_found"]) else 1
 
 
 if __name__ == "__main__":

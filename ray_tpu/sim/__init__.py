@@ -19,11 +19,19 @@ the rest of the tree now honors:
 partitions, gray-slow links, drain-under-churn, autoscaler flapping),
 checks invariants after every injected event, and emits a replayable
 trace artifact keyed by seed (``ray_tpu simulate``).
+
+``hunt.py`` + ``minimize.py`` turn the same determinism into a search
+engine (``ray_tpu hunt``): fault schedules become serializable genomes,
+a seeded mutator explores them guided by trace-derived coverage, and
+every invariant violation is ddmin-minimized to a 1-minimal replayable
+finding artifact.
 """
 
 from .campaign import CAMPAIGNS, CampaignResult, run_campaign
 from .cluster import SimCluster, SimParams
+from .hunt import Genome, HuntResult, hunt
 from .transport import SimTransport
 
 __all__ = ["SimTransport", "SimCluster", "SimParams", "run_campaign",
-           "CAMPAIGNS", "CampaignResult"]
+           "CAMPAIGNS", "CampaignResult", "Genome", "HuntResult",
+           "hunt"]

@@ -210,9 +210,19 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                  params: SimParams | None = None,
                  autoscale: bool = True, lock_order: bool = False,
                  serve: dict | None = None,
-                 out: str | None = None, progress=None) -> CampaignResult:
+                 out: str | None = None, progress=None,
+                 schedule: list | None = None,
+                 coverage=None) -> CampaignResult:
     """Execute one campaign; returns a :class:`CampaignResult` whose
-    ``trace_hash`` is the replay fingerprint."""
+    ``trace_hash`` is the replay fingerprint.
+
+    ``schedule`` overrides the generated fault schedule with an explicit
+    ``[(t, op, kwargs), ...]`` list (a hunt genome).  The job load is
+    still a pure function of (campaign, seed, nodes, duration) — job
+    draws precede fault draws on the Philox stream — so a (base args,
+    schedule) pair replays bit-identically.  ``coverage`` is an optional
+    sink (``hunt.RunCoverage``) attached to the trace; it observes every
+    event but never feeds the replay hash."""
     import numpy as np
 
     if duration is None:
@@ -225,12 +235,17 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         key=[int(seed) & (2 ** 64 - 1), 0xC0FFEE]))
     jobs, sched = build_schedule(campaign, rng, num_nodes, faults,
                                  duration)
+    if schedule is not None:
+        sched = sorted(((float(t), op, dict(kw))
+                        for t, op, kw in schedule), key=lambda e: e[0])
 
     if campaign == "head_failover_storm":
         # the storm IS the lease plane + hot standby under fire
         params = replace(params or SimParams.from_config(),
                          lease_plane=True, standby=True)
     cluster = SimCluster(num_nodes, seed=seed, params=params)
+    if coverage is not None:
+        cluster.trace.cov = coverage
     plane = None
     if campaign == "serve_diurnal":
         from .serve import SimServePlane
@@ -243,6 +258,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
             lockorder.install()
     acked: list[str] = []
     waves: list = []            # SimBroadcastWave, launch order
+    # invariants (bcast-reparent-cycle) audit the live waves directly
+    cluster.broadcast_waves = waves
     completed_cache = {"n": 0}
     fault_count = {"n": 0}
     inv_checks = {"n": 0}
@@ -368,21 +385,6 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
             while not all_done() and clock.monotonic() < settle_end:
                 clock.advance(cluster.params.heartbeat_period_s)
             check("final")
-            # broadcast waves: every wave terminal, every live member
-            # holding a full replica (re-parenting converged, no lost
-            # chunks — a completed member received every chunk exactly
-            # once by construction of the delivery model)
-            for w in waves:
-                if not w.terminal:
-                    violations.append(
-                        f"[final] broadcast wave {w.wave_id} never "
-                        f"became terminal")
-                    continue
-                left = w.unreached_live()
-                if left:
-                    violations.append(
-                        f"[final] broadcast wave {w.wave_id}: "
-                        f"{len(left)} live members without a replica")
             v, n = check_invariants(cluster, acked, strict=True)
             inv_checks["n"] += n
             trace.rec(clock.monotonic(), "invariant_check",
@@ -407,27 +409,58 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
     if plane is not None:
         result.stats["serve"] = plane.stats()
     if out:
-        write_artifact(out, result, trace, duration, faults)
+        write_artifact(out, result, trace, duration, faults,
+                       schedule=schedule, params=cluster.params)
     return result
+
+
+# config-knob prefixes snapshotted into every trace artifact: the full
+# resolved values reproduction depends on, so a replay is a pure
+# function of the artifact, never of the ambient env
+_KNOB_PREFIXES = ("chaos_", "lease_", "serve_", "sim_", "standby_",
+                  "rpc_breaker_", "rtlint_runtime_lock_order")
+
+
+def knob_snapshot() -> dict:
+    """Resolved ``chaos_*``/``lease_*``/``serve_*``/``sim_*``/
+    ``standby_*`` knob values at run time (env overrides folded in)."""
+    from ..common.config import get_config
+    cfg = get_config().to_dict()
+    return {k: cfg[k] for k in sorted(cfg)
+            if k.startswith(_KNOB_PREFIXES)}
 
 
 def write_artifact(path: str, result: CampaignResult, cluster_trace,
                    duration: float | None, faults: int | None = None,
-                   extra: dict | None = None) -> None:
+                   extra: dict | None = None,
+                   schedule: list | None = None,
+                   params: SimParams | None = None) -> None:
     """The replayable trace artifact: seed + parameters reproduce the
     run; the hash proves the reproduction matched.  ``replay`` holds
     the exact ``run_campaign`` arguments (``faults`` is the *requested*
-    count — the schedule key — not the injected total)."""
+    count — the schedule key — not the injected total; an explicit
+    ``schedule`` override is embedded verbatim), ``knobs`` the full
+    resolved config the run saw and ``params`` the resolved
+    :class:`SimParams` — so reproduction is a pure function of the
+    artifact, not of the ambient env."""
+    from dataclasses import asdict
+
     doc = {
         "format": "ray_tpu-sim-trace/1",
         "replay": {"nodes": result.nodes, "seed": result.seed,
                    "campaign": result.campaign, "faults": faults,
                    "duration": duration},
+        "knobs": knob_snapshot(),
         "result": result.to_dict(),
         "events_total": cluster_trace.total,
         "events_stored": len(cluster_trace.events),
         "events": cluster_trace.events,
     }
+    if schedule is not None:
+        doc["replay"]["schedule"] = [[t, op, kw]
+                                     for t, op, kw in schedule]
+    if params is not None:
+        doc["params"] = asdict(params)
     if extra:
         doc.update(extra)
     with open(path, "w", encoding="utf-8") as f:

@@ -62,12 +62,18 @@ _HEAD_ITEM_US = 5.0             # marginal cost per batched item
 class Trace:
     """Append-only campaign trace with an incremental sha256 over the
     canonical JSON of every event — the replay fingerprint.  Storage is
-    capped (artifacts stay small at 10k nodes); the hash is not."""
+    capped (artifacts stay small at 10k nodes); the hash is not.
+
+    ``cov`` is an optional coverage sink (``sim/hunt.py``'s
+    ``RunCoverage``): when attached it sees every event — including the
+    ones past the storage cap — but never feeds the hash, so attaching
+    it cannot perturb replay fingerprints."""
 
     def __init__(self):
         self.events: list[dict] = []
         self.total = 0
         self._h = hashlib.sha256()
+        self.cov = None
 
     def rec(self, t: float, kind: str, **fields) -> None:
         ev = {"t": round(t, 6), "kind": kind}
@@ -78,6 +84,8 @@ class Trace:
         self.total += 1
         if len(self.events) < _TRACE_EVENT_CAP:
             self.events.append(ev)
+        if self.cov is not None:
+            self.cov.note(ev)
 
     def hash(self) -> str:
         return self._h.hexdigest()
@@ -102,6 +110,12 @@ class SimParams:
     lease_max_classes: int = 64
     standby: bool = False
     standby_quorum: float = 0.34
+    # planted canary bug (r16, default off): the hunt's CI smoke and
+    # bench arm it to prove the adversarial search can find and
+    # minimize a real injected defect — with it on, a node death while
+    # ANY partition is active "loses" the dead node's running tasks
+    # (they are never requeued), so the strict final check fires
+    canary: bool = False
 
     @classmethod
     def from_config(cls) -> "SimParams":
@@ -671,6 +685,14 @@ class SimHead:
         t = self.tasks.get(tid)
         if t is None:
             return
+        # fence late acks from nodes the head already wrote off: the
+        # task was requeued when the node was declared dead/removed,
+        # and registering a copy on such a row would plant a phantom
+        # replica (the gray-window twin of the drain-path leak the r16
+        # hunt found).  The retry completes the task with a live copy.
+        nrow = self.nodes.get(nid)
+        if nrow is None or nrow["state"] in (DEAD, REMOVED):
+            return
         prev = t["node"]
         if prev is not None:
             prow = self.nodes.get(prev)
@@ -679,12 +701,10 @@ class SimHead:
                 prow["leased"].pop(tid, None)
                 if not prow["running"]:
                     prow["idle_since"] = self.clock.monotonic()
-        nrow = self.nodes.get(nid)
-        if nrow is not None:
-            nrow["running"].pop(tid, None)
-            nrow["leased"].pop(tid, None)
-            if not nrow["running"]:
-                nrow["idle_since"] = self.clock.monotonic()
+        nrow["running"].pop(tid, None)
+        nrow["leased"].pop(tid, None)
+        if not nrow["running"]:
+            nrow["idle_since"] = self.clock.monotonic()
         obj = self.objects.setdefault(oid,
                                       {"producer": tid, "copies": {}})
         obj["copies"][nid] = True
@@ -938,8 +958,6 @@ class SimHead:
         row["state"] = DEAD
         requeued = self._requeue_node(nid)
         self._revoke_node(nid, reason)
-        for oid in list(self.objects):
-            self.objects[oid]["copies"].pop(nid, None)
         self.trace.rec(self.clock.monotonic(), "node_dead", node=nid,
                        reason=reason, requeued=requeued)
         self._remove_node(nid, "dead")
@@ -947,10 +965,18 @@ class SimHead:
     def _requeue_node(self, nid: str) -> int:
         row = self.nodes[nid]
         requeued = 0
+        # canary (params.canary, default off): drop — instead of
+        # requeueing — the running set of a node that dies while a
+        # partition is live.  The hunt's smoke target: reachable only
+        # by composing two fault ops, so a schedule must be FOUND, and
+        # minimizable to exactly that pair.
+        lose = self.params.canary and bool(self.cluster.chaos.partitions)
         for tid in list(row["running"]):
             t = self.tasks.get(tid)
             if t is not None and t["state"] == "running" and \
                     t["node"] == nid:
+                if lose:
+                    continue
                 t["state"] = "pending"
                 t["node"] = None
                 self.pending.append(tid)
@@ -985,6 +1011,17 @@ class SimHead:
         if row["state"] != DEAD:
             self._requeue_node(nid)
             self._revoke_node(nid, reason)
+        # a removed node's replicas leave the cluster with it —
+        # whether it died or drained cleanly (drain migrates tasks,
+        # not objects).  Scrub its copy registrations so lineage
+        # repair sees the loss; a phantom copy on a REMOVED row would
+        # block reconstruction forever.  Found by the r16 hunt
+        # (tests/data/hunt_finding_object_copies_r16.json): the scrub
+        # used to run only on the death path, so a clean drain — e.g.
+        # the autoscaler removing post-failover surge capacity — leaked
+        # its replicas into the registry.
+        for oid in list(self.objects):
+            self.objects[oid]["copies"].pop(nid, None)
         row["state"] = REMOVED
         row["drain_started"] = None
         self.trace.rec(self.clock.monotonic(), "node_removed", node=nid,

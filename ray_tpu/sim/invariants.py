@@ -8,37 +8,108 @@ recovery machinery has had its deadline plus slack to act.  With the
 head down, head-derived checks are skipped (the campaign always
 restarts the head before the final strict pass).
 
-The five invariants, and the machinery each one proves:
+Every violation string is self-describing — ``[inv:<name> @t=<virtual
+seconds>] <detail>`` — so a failing campaign surfaces WHICH invariant
+fired and WHEN without digging through the trace; ``violation_names``
+parses the name back out (the hunt's failure signature and the
+minimizer's reproduction predicate both key on it).
 
-1. **no acked job lost** — persistence-before-ack + head restore
-2. **no lease stuck** — lost-ack lease requeue + death declaration
-3. **drains converge** — drain protocol + deadline force-removal
-4. **lineage reconstruction completes** — object-loss repair by
-   re-running producers (strict form: every acked job SUCCEEDED)
-5. **lock-order digraph stays acyclic** — the runtime lock-order
-   recorder (``common/lockorder.py``), when installed, over the real
-   locks the simulation exercises (chaos links, breakers)
-6. **serve plane conserves requests and reclaims loans** — when a
-   ``serve_diurnal`` campaign installed a ``SimServePlane``: every
-   accepted request is accounted for in some queue (strictly:
-   completed), and capacity loans converge to reclaimed-or-booked-lost
-7. **no double-executed lease after epoch revocation** — lease plane
-   (r15): once the head revokes a node's epoch, no task may *start*
-   on that node under the revoked epoch past the grace window.  The
-   raylet self-fences at the same horizon the head uses to declare it
-   dead, so every start in ``cluster.exec_log`` must carry an epoch
-   that is current for its node — or predate the revocation + grace.
-   Invariant 1 doubles as the failover check: acked jobs must survive
-   a standby promotion, because promotion is just ``start_head()``
-   over the same persisted tables.
+The invariants, and the machinery each one proves:
+
+- **acked-job-lost** — persistence-before-ack + head restore (doubles
+  as the failover check: acked jobs must survive a standby promotion,
+  because promotion is just ``start_head()`` over the same persisted
+  tables)
+- **lease-stuck** / **leased-quiet** — lost-ack lease requeue + death
+  declaration; lease-plane form: a locally-admitted grant the raylet
+  stopped reporting must be revoked+requeued by the TTL sweep
+- **drain-stuck** — drain protocol + deadline force-removal
+- **lineage-hole** / **job-incomplete** (strict) — object-loss repair
+  by re-running producers (a job whose lost outputs were never rebuilt
+  cannot finish)
+- **lock-order-cycle** — the runtime lock-order recorder
+  (``common/lockorder.py``), when installed, over the real locks the
+  simulation exercises (chaos links, breakers)
+- **serve-accounting** / **serve-conservation** / **loan-drain-stuck**
+  / **loan-conservation** / **serve-incomplete** / **loans-outstanding**
+  — the serve plane (when a ``serve_diurnal`` campaign installed one):
+  every accepted request is accounted for in some queue (strictly:
+  completed), and capacity loans conserve —
+  ``loans_total == active + reclaimed + lost`` even across
+  SIGKILL-mid-reclaim — and converge to reclaimed-or-booked-lost
+- **lease-double-exec** — lease plane (r15): once the head revokes a
+  node's epoch, no task may *start* on that node under the revoked
+  epoch past the grace window.  The raylet self-fences at the same
+  horizon the head uses to declare it dead, so every start in
+  ``cluster.exec_log`` must carry an epoch that is current for its
+  node — or predate the revocation + grace.
+- **object-copies** (r16) — the head's object registry never claims a
+  replica on a node it has itself declared DEAD/REMOVED: no object is
+  "lost" behind a phantom copy while a real replica's node is alive.
+  Death declaration, drain removal and late gray-window done-acks must
+  all keep the copy map consistent with the node table.
+- **bcast-reparent-cycle** (r16) — broadcast re-parenting never forms
+  a cycle: every live member of an active wave reaches the root
+  through finitely many parents.
+- **bcast-wave-terminal** / **bcast-live-replica** (strict) — by
+  quiesce every wave reached a terminal state and every live member
+  holds a full replica (previously inline in the campaign runner).
+- **revocation-epoch-monotonic** (r16) — a node's revocation epochs
+  strictly increase, across head kills and standby promotions: a
+  promoted head that re-issued a journaled epoch would break the
+  at-most-once execution fence.
 """
 
 from __future__ import annotations
 
-__all__ = ["check_invariants"]
+import re
+
+__all__ = ["check_invariants", "INVARIANTS", "violation_names"]
+
+# name -> what the invariant proves (the fire/quiet twin tests and the
+# hunt's coverage signal both enumerate this registry)
+INVARIANTS = {
+    "acked-job-lost": "persist-before-ack + head restore/promotion",
+    "lease-stuck": "lost-ack lease requeue by the monitor",
+    "leased-quiet": "quiet locally-admitted grants revoked by TTL sweep",
+    "drain-stuck": "drain convergence + deadline force-removal",
+    "lineage-hole": "lost outputs rebuilt by re-running producers",
+    "job-incomplete": "strict final: every acked job SUCCEEDED",
+    "lock-order-cycle": "runtime lock acquisition digraph acyclic",
+    "serve-accounting": "outstanding counter == structural queue sum",
+    "serve-conservation": "accepted == completed + outstanding",
+    "loan-drain-stuck": "loan reclaim drains converge by deadline",
+    "loan-conservation": "loans_total == active + reclaimed + lost",
+    "serve-incomplete": "strict final: every accepted request completed",
+    "loans-outstanding": "strict final: no loan left unreclaimed",
+    "lease-double-exec": "no start under a revoked epoch past grace",
+    "object-copies": "no phantom replica on a DEAD/REMOVED node",
+    "bcast-reparent-cycle": "broadcast parent chains stay acyclic",
+    "revocation-epoch-monotonic": "revocation epochs strictly increase",
+    "bcast-wave-terminal": "strict final: every wave reaches terminal",
+    "bcast-live-replica": "strict final: live wave members hold replicas",
+}
+
+_NAME_RE = re.compile(r"\[inv:([a-z0-9-]+) @t=")
 
 
-def _check_exec_log(cluster, grace: float) -> tuple[list[str], int]:
+def violation_names(violations) -> frozenset:
+    """The set of invariant names present in a violation list — the
+    failure signature the hunt dedupes on and the minimizer preserves."""
+    names = set()
+    for v in violations:
+        m = _NAME_RE.search(v)
+        if m:
+            names.add(m.group(1))
+    return frozenset(names)
+
+
+def fmt_violation(name: str, now: float, msg: str) -> str:
+    return f"[inv:{name} @t={now:.1f}] {msg}"
+
+
+def _check_exec_log(cluster, grace: float, now: float
+                    ) -> tuple[list[str], int]:
     """Scan lease-plane starts against the revocation log.  Incremental:
     starts already audited are dropped, so a 10k-node campaign pays for
     each start once.  A start under epoch ``e`` on node ``n`` violates
@@ -56,15 +127,109 @@ def _check_exec_log(cluster, grace: float) -> tuple[list[str], int]:
             continue
         for e_r, t_r in revs:
             if e_r > epoch and t_start > t_r + grace:
-                violations.append(
-                    f"double-executed lease: {tid} started on "
-                    f"{nid} at t={t_start:.3f} under epoch "
-                    f"{epoch}, revoked to {e_r} at t={t_r:.3f}")
+                violations.append(fmt_violation(
+                    "lease-double-exec", now,
+                    f"{tid} started on {nid} at t={t_start:.3f} under "
+                    f"epoch {epoch}, revoked to {e_r} at t={t_r:.3f}"))
                 break
     # a start can never become violating later (a future revocation's
     # t_r is >= now > t_start): audited entries are done for good
     cluster.exec_audited += checks
     del log[:]
+    return violations, checks
+
+
+def _check_object_copies(head, now: float) -> tuple[list[str], int]:
+    """object-copies: every replica the registry claims lives on a node
+    the head still considers ALIVE or DRAINING.  Death declaration and
+    removal scrub synchronously, so no grace window is needed."""
+    violations: list[str] = []
+    checks = 0
+    dead_rows = {nid for nid, row in head.nodes.items()
+                 if row["state"] in ("dead", "removed")}
+    for oid, obj in head.objects.items():
+        checks += 1
+        if not dead_rows:
+            continue
+        phantom = [nid for nid in obj["copies"] if nid in dead_rows]
+        if phantom:
+            violations.append(fmt_violation(
+                "object-copies", now,
+                f"{oid} claims replicas on dead/removed "
+                f"{','.join(phantom)} (live copies: "
+                f"{len(obj['copies']) - len(phantom)})"))
+    return violations, checks
+
+
+def _check_broadcast_cycles(cluster, now: float) -> tuple[list[str], int]:
+    """bcast-reparent-cycle: in every active wave, each live member's
+    parent chain reaches the root in <= |members|+1 hops."""
+    violations: list[str] = []
+    checks = 0
+    waves = getattr(cluster, "broadcast_waves", None) or ()
+    for w in waves:
+        if w.t_done is not None:
+            continue
+        bound = len(w.members) + 1
+        ok: set = {w.root}
+        for m in w.members:
+            if not w._alive(m):
+                continue
+            checks += 1
+            node, path, hops = m, [], 0
+            while node is not None and node not in ok and hops <= bound:
+                path.append(node)
+                node = w.parent_of.get(node)
+                hops += 1
+            if hops > bound:
+                violations.append(fmt_violation(
+                    "bcast-reparent-cycle", now,
+                    f"wave {w.wave_id}: {m}'s parent chain cycles "
+                    f"({'->'.join(path[:6])}...)"))
+            else:
+                ok.update(path)
+    return violations, checks
+
+
+def _check_waves_final(cluster, now: float) -> tuple[list[str], int]:
+    """Strict final wave checks: every wave terminal, every live member
+    holding a full replica (re-parenting converged, no lost chunks — a
+    completed member received every chunk exactly once by construction
+    of the delivery model)."""
+    violations: list[str] = []
+    checks = 0
+    for w in (getattr(cluster, "broadcast_waves", None) or ()):
+        checks += 1
+        if not w.terminal:
+            violations.append(fmt_violation(
+                "bcast-wave-terminal", now,
+                f"broadcast wave {w.wave_id} never became terminal"))
+            continue
+        left = w.unreached_live()
+        if left:
+            violations.append(fmt_violation(
+                "bcast-live-replica", now,
+                f"broadcast wave {w.wave_id}: {len(left)} live "
+                f"members without a replica"))
+    return violations, checks
+
+
+def _check_epoch_monotonic(cluster, now: float) -> tuple[list[str], int]:
+    """revocation-epoch-monotonic: per node, revocation epochs strictly
+    increase in revocation order — across kills and promotions."""
+    violations: list[str] = []
+    checks = 0
+    for nid, revs in cluster.revocation_log.items():
+        checks += 1
+        prev = None
+        for epoch, t_r in revs:
+            if prev is not None and epoch <= prev:
+                violations.append(fmt_violation(
+                    "revocation-epoch-monotonic", now,
+                    f"{nid} revoked to epoch {epoch} at t={t_r:.3f} "
+                    f"after already reaching {prev}"))
+                break
+            prev = epoch
     return violations, checks
 
 
@@ -83,13 +248,16 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
     p = cluster.params
     grace = 2.0 * p.heartbeat_period_s
 
+    def v(name: str, msg: str) -> None:
+        violations.append(fmt_violation(name, now, msg))
+
     if head is not None and head.alive:
-        # 1. no acked job lost
+        # acked-job-lost
         for jid in acked_jobs:
             checks += 1
             if jid not in head.jobs:
-                violations.append(f"acked job lost: {jid}")
-        # 2. no lease stuck (monitor requeues at lease_timeout)
+                v("acked-job-lost", f"acked job lost: {jid}")
+        # lease-stuck / leased-quiet (monitor requeues at lease_timeout)
         for nid in head._node_order:
             row = head.nodes.get(nid)
             if row is None:
@@ -100,27 +268,25 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
                     continue
                 checks += 1
                 if now - t["granted_at"] > p.lease_timeout_s + grace:
-                    violations.append(
-                        f"lease stuck: {tid} on {nid} for "
-                        f"{now - t['granted_at']:.1f}s")
+                    v("lease-stuck",
+                      f"{tid} on {nid} running for "
+                      f"{now - t['granted_at']:.1f}s")
             # lease-plane form: a locally-admitted grant the raylet
             # stopped reporting must be revoked+requeued by the sweep
             for tid, last in row["leased"].items():
                 checks += 1
                 if now - last > p.lease_timeout_s + grace:
-                    violations.append(
-                        f"leased task stuck: {tid} on {nid} quiet "
-                        f"for {now - last:.1f}s")
-            # 3. drains converge (deadline force-removal backstop)
+                    v("leased-quiet",
+                      f"{tid} on {nid} quiet for {now - last:.1f}s")
+            # drain-stuck (deadline force-removal backstop)
             if row["state"] == "draining":
                 checks += 1
                 started = row["drain_started"]
                 if started is not None and \
                         now - started > p.drain_deadline_s + grace:
-                    violations.append(
-                        f"drain not converged: {nid} draining for "
-                        f"{now - started:.1f}s")
-        # 4. lineage: an output every incomplete job still needs must
+                    v("drain-stuck",
+                      f"{nid} draining for {now - started:.1f}s")
+        # lineage: an output every incomplete job still needs must
         # have a copy, or its producer must already be requeued/running
         for jid, job in head.jobs.items():
             if job["status"] == "succeeded":
@@ -132,9 +298,9 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
                 checks += 1
                 obj = head.objects.get(t["oid"])
                 if (obj is None or not obj["copies"]) and strict:
-                    violations.append(
-                        f"lineage hole: {t['oid']} of {jid} has no "
-                        f"copies and producer {tid} is not requeued")
+                    v("lineage-hole",
+                      f"{t['oid']} of {jid} has no copies and "
+                      f"producer {tid} is not requeued")
         if strict:
             for jid in acked_jobs:
                 checks += 1
@@ -143,37 +309,51 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
                     n_done = sum(
                         1 for tid in job["tasks"]
                         if head.tasks[tid]["state"] == "done")
-                    violations.append(
-                        f"acked job incomplete after quiesce: {jid} "
-                        f"({n_done}/{len(job['tasks'])} tasks done)")
+                    v("job-incomplete",
+                      f"acked job incomplete after quiesce: {jid} "
+                      f"({n_done}/{len(job['tasks'])} tasks done)")
+        # object-copies: registry vs node-table consistency
+        cv, cn = _check_object_copies(head, now)
+        violations.extend(cv)
+        checks += cn
 
-    # 6. serve plane (when a serve_diurnal campaign installed one):
-    # accepted requests are conserved — counter matches the structural
-    # sum of every queue — and loan drains converge; strictly, every
-    # accepted request completed and every loan was reclaimed or its
-    # loss booked
+    # serve plane (when a serve_diurnal campaign installed one)
     plane = getattr(cluster, "serve_plane", None)
     if plane is not None and plane.started:
-        v, n = plane.check(strict=strict, now=now, grace=grace)
-        violations.extend(v)
-        checks += n
+        sv, sn = plane.check(strict=strict, now=now, grace=grace)
+        violations.extend(sv)
+        checks += sn
 
-    # 7. no double-executed lease after epoch revocation (lease plane);
-    # head-independent: the logs live on the cluster, so this audits
-    # through head-down windows and across standby promotions
+    # lease-double-exec; head-independent: the logs live on the
+    # cluster, so this audits through head-down windows and across
+    # standby promotions
     if cluster.params.lease_plane:
-        v, n = _check_exec_log(cluster, grace)
-        violations.extend(v)
-        checks += n
+        ev, en = _check_exec_log(cluster, grace, now)
+        violations.extend(ev)
+        checks += en
 
-    # 5. runtime lock-order digraph stays acyclic (when the recorder
-    # is armed — see rtlint_runtime_lock_order)
+    # bcast-reparent-cycle over the campaign's live waves
+    bv, bn = _check_broadcast_cycles(cluster, now)
+    violations.extend(bv)
+    checks += bn
+    if strict:
+        wv, wn = _check_waves_final(cluster, now)
+        violations.extend(wv)
+        checks += wn
+
+    # revocation-epoch-monotonic (head-independent, like the exec log)
+    mv, mn = _check_epoch_monotonic(cluster, now)
+    violations.extend(mv)
+    checks += mn
+
+    # lock-order-cycle (when the recorder is armed — see
+    # rtlint_runtime_lock_order)
     from ..common import lockorder
     if lockorder.installed():
         checks += 1
         try:
             lockorder.assert_acyclic()
         except AssertionError as e:
-            violations.append(f"lock-order cycle: {e}")
+            v("lock-order-cycle", str(e))
 
     return violations, checks

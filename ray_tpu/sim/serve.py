@@ -495,9 +495,14 @@ class SimServePlane:
               grace: float = 10.0) -> tuple[list[str], int]:
         """Serve-plane invariants, called from
         :func:`sim.invariants.check_invariants`: accepted requests are
-        never lost (counter vs structural sum), loan drains converge,
-        and — strictly, after quiesce — everything accepted completed
-        and every loan was reclaimed or booked lost."""
+        never lost (counter vs structural sum), loans conserve
+        (``loans_total == active + reclaimed + lost`` — a SIGKILL
+        mid-reclaim must book the loss exactly once, never zero or
+        twice), loan drains converge, and — strictly, after quiesce —
+        everything accepted completed and every loan was reclaimed or
+        booked lost."""
+        from .invariants import fmt_violation
+
         violations: list[str] = []
         checks = 0
         if now is None:
@@ -507,15 +512,26 @@ class SimServePlane:
             self.in_route + \
             sum(r.load() for r in self.replicas.values())
         if accounted != self.outstanding:
-            violations.append(
-                f"serve accounting leak: {self.outstanding} outstanding "
-                f"by counter, {accounted} accounted in queues")
+            violations.append(fmt_violation(
+                "serve-accounting", now,
+                f"{self.outstanding} outstanding by counter, "
+                f"{accounted} accounted in queues"))
         checks += 1
         if self.accepted != self.completed + self.outstanding:
-            violations.append(
-                f"serve conservation broken: accepted={self.accepted} "
-                f"!= completed={self.completed} + "
-                f"outstanding={self.outstanding}")
+            violations.append(fmt_violation(
+                "serve-conservation", now,
+                f"accepted={self.accepted} != "
+                f"completed={self.completed} + "
+                f"outstanding={self.outstanding}"))
+        checks += 1
+        if self.loans_total != (len(self.loans) + self.reclaims_total +
+                                self.loans_lost):
+            violations.append(fmt_violation(
+                "loan-conservation", now,
+                f"loans_total={self.loans_total} != "
+                f"active={len(self.loans)} + "
+                f"reclaimed={self.reclaims_total} + "
+                f"lost={self.loans_lost}"))
         drain_cap = self.cluster.params.drain_deadline_s + grace
         for nid, loan in self.loans.items():
             if loan["state"] != "draining":
@@ -523,19 +539,22 @@ class SimServePlane:
             checks += 1
             if now - loan["t_drain"] > drain_cap and \
                     self._node_alive(nid):
-                violations.append(
-                    f"loan drain not converged: {nid} draining for "
-                    f"{now - loan['t_drain']:.1f}s")
+                violations.append(fmt_violation(
+                    "loan-drain-stuck", now,
+                    f"{nid} draining for "
+                    f"{now - loan['t_drain']:.1f}s"))
         if strict:
             checks += 2
             if self.outstanding:
-                violations.append(
+                violations.append(fmt_violation(
+                    "serve-incomplete", now,
                     f"{self.outstanding} accepted requests never "
-                    f"completed after quiesce")
+                    f"completed after quiesce"))
             if self.loans:
-                violations.append(
+                violations.append(fmt_violation(
+                    "loans-outstanding", now,
                     f"{len(self.loans)} loans neither reclaimed nor "
-                    f"booked lost after quiesce")
+                    f"booked lost after quiesce"))
         return violations, checks
 
     # -- reporting -----------------------------------------------------------

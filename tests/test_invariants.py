@@ -1,0 +1,266 @@
+"""Fire/quiet twin tests for every invariant in ``sim/invariants.py``.
+
+rtlint-style discipline: each named invariant demonstrably FIRES on a
+deliberately corrupted sim state and stays QUIET on a healthy one, so a
+refactor can neither silently disable a checker nor make one
+trigger-happy.  The corruption table is keyed by the ``INVARIANTS``
+registry and asserted complete — adding an invariant without its twin
+fails ``test_every_invariant_has_a_twin``.
+
+The healthy fixture is a real 4-node cluster (lease plane on) that ran
+a job to completion, with a quiet serve plane attached, legal
+revocation history, and terminal + active broadcast waves present — so
+the quiet half actually exercises every checker's pass path, not just
+its absence.
+"""
+
+from dataclasses import replace
+
+import pytest
+
+from ray_tpu.sim.cluster import HEAD_ADDR, SimCluster, SimParams
+from ray_tpu.sim.invariants import (INVARIANTS, check_invariants,
+                                    violation_names)
+
+
+class _StubWave:
+    """Duck-typed stand-in for ``SimBroadcastWave`` — just the surface
+    the invariant checkers read."""
+
+    def __init__(self, wave_id="w0", members=("a", "b", "c"), root="a",
+                 parent_of=None, t_done=None, terminal=True,
+                 unreached=()):
+        self.wave_id = wave_id
+        self.members = list(members)
+        self.root = root
+        self.parent_of = dict(parent_of or {})
+        self.t_done = t_done
+        self.terminal = terminal
+        self._unreached = list(unreached)
+
+    def _alive(self, nid):
+        return True
+
+    def unreached_live(self):
+        return list(self._unreached)
+
+
+def _healthy_cluster():
+    """A cluster where every checker is active and quiet: completed
+    job, lease plane on (exec log populated), balanced serve counters,
+    legal revocation history, one finished + one in-flight acyclic
+    broadcast wave."""
+    from ray_tpu.sim.serve import SimServePlane
+
+    cluster = SimCluster(4, seed=1, params=replace(
+        SimParams.from_config(), lease_plane=True))
+    cluster.__enter__()
+    driver = cluster.transport.connect(HEAD_ADDR, _sim_src="driver")
+    cluster.clock.run_until(10.0)
+    assert driver.call("job_submit", "j1",
+                       {f"j1.t{i}": 5.0 for i in range(4)}) == "ack"
+    cluster.clock.run_until(80.0)
+    assert cluster.head.jobs["j1"]["status"] == "succeeded"
+
+    plane = SimServePlane(cluster, seed=0, duration=50.0)
+    plane.started = True            # active but load-free: all zeros...
+    plane.accepted = plane.completed = 2
+    plane.loans_total = plane.reclaims_total = 1    # ...and balanced
+    cluster.serve_plane = plane
+
+    # legal revocation history: strictly increasing epochs
+    cluster.revocation_log["n00003"] = [(1, 5.0), (2, 6.0)]
+    cluster.broadcast_waves = [
+        _StubWave("w0", t_done=5.0, terminal=True),
+        _StubWave("w1", t_done=None, terminal=False,
+                  parent_of={"b": "a", "c": "b"}),
+    ]
+    return cluster, ["j1"]
+
+
+def _now(cluster):
+    return cluster.clock.monotonic()
+
+
+# -- the corruption table -----------------------------------------------------
+# name -> (corrupt(cluster, acked), strict) such that after corrupt()
+# the named invariant fires under check_invariants(strict=strict)
+
+def _acked_job_lost(c, acked):
+    acked.append("ghost-job")
+
+
+def _lease_stuck(c, acked):
+    head = c.head
+    tid = "j1.t0"
+    t = head.tasks[tid]
+    t["state"], t["node"] = "running", "n00001"
+    t["granted_at"] = _now(c) - 100.0
+    head.nodes["n00001"]["running"][tid] = True
+
+
+def _leased_quiet(c, acked):
+    c.head.nodes["n00001"]["leased"]["j1.t0"] = _now(c) - 100.0
+
+
+def _drain_stuck(c, acked):
+    row = c.head.nodes["n00001"]
+    row["state"] = "draining"
+    row["drain_started"] = _now(c) - 1000.0
+
+
+def _lineage_hole(c, acked):
+    head = c.head
+    head.jobs["j1"]["status"] = "running"
+    head.objects[head.tasks["j1.t0"]["oid"]]["copies"].clear()
+
+
+def _job_incomplete(c, acked):
+    head = c.head
+    head.jobs["j1"]["status"] = "running"
+    t = head.tasks["j1.t1"]
+    t["state"], t["node"] = "pending", None
+
+
+def _lock_order_cycle(c, acked):
+    from ray_tpu.common import lockorder
+    lockorder.install()
+    lockorder._edges[("siteA:1", "siteB:2")] = 1
+    lockorder._edges[("siteB:2", "siteA:1")] = 1
+
+
+def _serve_accounting(c, acked):
+    c.serve_plane.outstanding += 3
+
+
+def _serve_conservation(c, acked):
+    c.serve_plane.accepted += 3
+
+
+def _loan_drain_stuck(c, acked):
+    p = c.serve_plane
+    p.loans["n00001"] = {"state": "draining", "t0": 0.0,
+                         "t_drain": _now(c) - 1000.0}
+    p.loans_total += 1          # keep loan-conservation quiet
+
+
+def _loan_conservation(c, acked):
+    c.serve_plane.loans_total += 1
+
+
+def _serve_incomplete(c, acked):
+    p = c.serve_plane
+    p.accepted += 1
+    p.outstanding += 1
+    p.shards[0].queue.append((99, 0.0))     # keep accounting balanced
+
+
+def _loans_outstanding(c, acked):
+    p = c.serve_plane
+    p.loans["n00001"] = {"state": "active", "t0": 0.0, "t_drain": 0.0}
+    p.loans_total += 1
+
+
+def _lease_double_exec(c, acked):
+    c.revocation_log["n00002"] = [(5, 10.0)]
+    c.exec_log.append(("ghost-task", "n00002", 4, _now(c)))
+
+
+def _object_copies(c, acked):
+    head = c.head
+    oid = head.tasks["j1.t0"]["oid"]
+    head.objects[oid]["copies"]["n00002"] = True
+    head.nodes["n00002"]["state"] = "removed"
+
+
+def _bcast_reparent_cycle(c, acked):
+    c.broadcast_waves.append(_StubWave(
+        "w-cyc", t_done=None, terminal=False,
+        parent_of={"b": "c", "c": "b"}))
+
+
+def _revocation_epoch_monotonic(c, acked):
+    c.revocation_log["n00001"] = [(3, 1.0), (3, 2.0)]
+
+
+def _bcast_wave_terminal(c, acked):
+    # strict final with the in-flight wave still not terminal
+    pass
+
+
+def _bcast_live_replica(c, acked):
+    _finish_waves(c)
+    c.broadcast_waves.append(_StubWave(
+        "w-gap", t_done=6.0, terminal=True, unreached=("b",)))
+
+
+def _finish_waves(c):
+    for w in c.broadcast_waves:
+        if w.t_done is None:
+            w.t_done, w.terminal = _now(c), True
+
+
+CORRUPTIONS = {
+    "acked-job-lost": (_acked_job_lost, False),
+    "lease-stuck": (_lease_stuck, False),
+    "leased-quiet": (_leased_quiet, False),
+    "drain-stuck": (_drain_stuck, False),
+    "lineage-hole": (_lineage_hole, True),
+    "job-incomplete": (_job_incomplete, True),
+    "lock-order-cycle": (_lock_order_cycle, False),
+    "serve-accounting": (_serve_accounting, False),
+    "serve-conservation": (_serve_conservation, False),
+    "loan-drain-stuck": (_loan_drain_stuck, False),
+    "loan-conservation": (_loan_conservation, False),
+    "serve-incomplete": (_serve_incomplete, True),
+    "loans-outstanding": (_loans_outstanding, True),
+    "lease-double-exec": (_lease_double_exec, False),
+    "object-copies": (_object_copies, False),
+    "bcast-reparent-cycle": (_bcast_reparent_cycle, False),
+    "revocation-epoch-monotonic": (_revocation_epoch_monotonic, False),
+    "bcast-wave-terminal": (_bcast_wave_terminal, True),
+    "bcast-live-replica": (_bcast_live_replica, True),
+}
+
+
+def test_every_invariant_has_a_twin():
+    assert set(CORRUPTIONS) == set(INVARIANTS)
+
+
+@pytest.mark.parametrize("name", sorted(INVARIANTS))
+def test_invariant_fires_on_corrupted_state(name):
+    from ray_tpu.common import lockorder
+
+    corrupt, strict = CORRUPTIONS[name]
+    cluster, acked = _healthy_cluster()
+    try:
+        corrupt(cluster, acked)
+        if strict and name not in ("bcast-wave-terminal",):
+            _finish_waves(cluster)
+        v, checks = check_invariants(cluster, acked, strict=strict)
+        assert name in violation_names(v), (name, v)
+        # self-describing format: name + virtual time in every message
+        assert any(f"[inv:{name} @t=" in msg for msg in v)
+        assert checks > 0
+    finally:
+        if name == "lock-order-cycle":
+            lockorder.reset()
+            lockorder.uninstall()
+        cluster.close()
+
+
+@pytest.mark.parametrize("name", sorted(INVARIANTS))
+def test_invariant_quiet_on_healthy_state(name):
+    cluster, acked = _healthy_cluster()
+    try:
+        v, checks = check_invariants(cluster, acked, strict=False)
+        assert name not in violation_names(v), (name, v)
+        assert v == []
+        # strict pass mirrors campaign quiesce: waves finished first
+        _finish_waves(cluster)
+        v, _ = check_invariants(cluster, acked, strict=True)
+        assert name not in violation_names(v), (name, v)
+        assert v == []
+        assert checks > 0
+    finally:
+        cluster.close()

@@ -17,7 +17,8 @@ import pytest
 
 from ray_tpu.common.clock import VirtualClock
 from ray_tpu.rpc.client import RpcConnectionError
-from ray_tpu.sim import CAMPAIGNS, SimCluster, run_campaign
+from ray_tpu.sim import (CAMPAIGNS, CampaignResult, SimCluster,
+                         run_campaign)
 from ray_tpu.sim.cluster import HEAD_ADDR
 from ray_tpu.sim.invariants import check_invariants
 
@@ -111,6 +112,98 @@ def test_trace_artifact_format(tmp_path):
     assert doc["result"]["trace_hash"] == r.trace_hash
     assert doc["events_total"] == len(doc["events"])
     assert doc["events"][0]["kind"] == "cluster_start"
+    # r16: the artifact embeds the resolved knob snapshot and the
+    # resolved SimParams, so reproduction is a pure function of the
+    # artifact rather than the ambient env
+    from ray_tpu.common.config import get_config
+    cfg = get_config().to_dict()
+    assert doc["knobs"]
+    for k, v in doc["knobs"].items():
+        assert k.startswith(("chaos_", "lease_", "serve_", "sim_",
+                             "standby_", "rpc_breaker_",
+                             "rtlint_runtime_lock_order"))
+        assert cfg[k] == v
+    assert "sim_heartbeat_period_s" in doc["knobs"]
+    assert doc["params"]["heartbeat_period_s"] == \
+        doc["knobs"]["sim_heartbeat_period_s"]
+    assert doc["params"]["canary"] is False
+
+
+def test_trace_artifact_embeds_explicit_schedule(tmp_path):
+    """A schedule override (a hunt genome) is embedded verbatim, and
+    replaying (base args + embedded schedule) is bit-identical."""
+    out = tmp_path / "trace.json"
+    sched = [(20.0, "kill_node", {"node": "n00001"}),
+             (40.0, "partition",
+              {"pairs": [["sim://head", "sim://n00002"]]}),
+             (55.0, "heal",
+              {"pairs": [["sim://head", "sim://n00002"]]})]
+    kw = dict(seed=5, campaign="mixed", faults=6, duration=120.0)
+    r = run_campaign(24, schedule=sched, out=str(out), **kw)
+    doc = json.loads(out.read_text())
+    embedded = [(t, op, kw2) for t, op, kw2 in
+                doc["replay"]["schedule"]]
+    r2 = run_campaign(24, schedule=embedded, **kw)
+    assert r2.trace_hash == r.trace_hash
+    assert r.faults_injected == 3
+
+
+def test_verify_replay_mismatch_prints_hashes_and_fails(monkeypatch,
+                                                        capsys):
+    """``--verify-replay`` failure must surface BOTH hashes and exit
+    non-zero (the campaign itself is deterministic, so the divergent
+    second run is injected)."""
+    import argparse
+    import itertools
+
+    import ray_tpu.sim as sim_pkg
+    from ray_tpu.scripts.cli import cmd_simulate
+
+    hashes = itertools.count()
+
+    def fake_run_campaign(*a, **kw):
+        return CampaignResult(
+            nodes=8, seed=0, campaign="mixed", faults_injected=1,
+            jobs_acked=1, jobs_completed=1, events_fired=10,
+            invariant_checks=5, violations=[],
+            trace_hash=f"deadbeef{next(hashes)}")
+
+    monkeypatch.setattr(sim_pkg, "run_campaign", fake_run_campaign)
+    args = argparse.Namespace(
+        nodes=8, seed=0, campaign="mixed", faults=1, duration=60.0,
+        no_autoscale=False, out=None, verify_replay=True)
+    rc = cmd_simulate(args)
+    cap = capsys.readouterr()
+    assert rc == 1
+    assert "deadbeef0" in cap.err and "deadbeef1" in cap.err
+    summary = json.loads(cap.out)
+    assert summary["replay_matches"] is False
+    assert any("replay hash mismatch" in v
+               for v in summary["violations"])
+
+
+def test_campaign_violation_report_is_self_describing():
+    """A failing campaign surfaces WHICH invariant fired and WHEN: the
+    canary genome loses tasks, and every violation message carries the
+    [inv:<name> @t=<virtual s>] prefix the CLI and the hunt key on."""
+    from dataclasses import replace as _dc_replace
+
+    from ray_tpu.sim import SimParams
+    from ray_tpu.sim.invariants import violation_names
+
+    sched = [(30.0, "partition",
+              {"pairs": [["sim://head", "sim://n00001"],
+                         ["sim://n00001", "sim://head"]]}),
+             (45.0, "kill_node", {"node": "n00002"})]
+    r = run_campaign(8, seed=3, campaign="mixed", faults=4,
+                     duration=120.0, schedule=sched,
+                     params=_dc_replace(SimParams.from_config(),
+                                        canary=True))
+    assert not r.ok
+    assert "job-incomplete" in violation_names(r.violations)
+    import re
+    for v in r.violations:
+        assert re.search(r"\[inv:[a-z0-9-]+ @t=\d+", v), v
 
 
 # -- head failover ------------------------------------------------------------

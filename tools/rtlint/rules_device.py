@@ -37,7 +37,11 @@ import re
 from .finding import Finding
 
 _SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/", "ray_tpu/leasing/")
-_EXTRA_FILES = ("ray_tpu/runtime/raylet.py",)
+# single files pulled into scope without scoping their whole package:
+# the sim search loop (hunt/minimize) must never touch a device —
+# thousands of probe runs per hunt would serialize on any sync point
+_EXTRA_FILES = ("ray_tpu/runtime/raylet.py", "ray_tpu/sim/hunt.py",
+                "ray_tpu/sim/minimize.py")
 _NP_COERCIONS = ("asarray", "array")
 
 

@@ -40,7 +40,11 @@ from .finding import Finding
 _CLOCK_FNS = ("time", "monotonic", "sleep")
 _SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/",
            "ray_tpu/leasing/", "ray_tpu/serve/gossip.py",
-           "ray_tpu/serve/loaning.py")
+           "ray_tpu/serve/loaning.py",
+           # the hunt must be a pure function of its Philox seed:
+           # wall-clock reads would make search order (and therefore
+           # findings) machine-dependent — callers time it themselves
+           "ray_tpu/sim/hunt.py", "ray_tpu/sim/minimize.py")
 _TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/",
                     "ray_tpu/leasing/")
 _EXEMPT = ("ray_tpu/common/clock.py", "ray_tpu/rpc/transport.py")
