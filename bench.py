@@ -31,6 +31,12 @@ water-fill+argmin, counts readback) and the delta-beat hit rate over
 a churn workload driven through the real ClusterResourceManager dirty
 journal (scheduling/cluster_resources.py delta_view ->
 scheduling/policy.py DeltaScheduler).
+
+r17 adds the ``budget_beat`` stage on every path (device, smoke, and
+graceful skip): per-(class, node) lease budgets ride the beat's single
+packed readback, the timed loop includes the board publish that feeds
+the lease grantor, and the record carries the device-vs-CPU-oracle
+budget parity gate plus ``readbacks_per_beat: 1``.
 """
 
 import json
@@ -291,6 +297,94 @@ def sharded_delta_bench(n_nodes: int = 512, n_classes: int = 48,
     return rec
 
 
+def budget_beat_bench(n_nodes: int = 256, n_classes: int = 24,
+                      beats: int = 20, churn: int = 16,
+                      seed: int = 0, shards: int = 0) -> dict:
+    """The r17 tentpole stage: the fused beat emits per-(class, node)
+    lease budgets INSIDE its single packed readback, and the timed
+    region covers the full loop a raylet heartbeat runs — churned
+    beat, packed counts+budgets fetch, and the board publish that
+    re-keys budget rows for the lease grantor.  Parity gate: the
+    final beat's budget rows must be bit-identical to the CPU oracle
+    twin (``contract.compute_budgets`` on the post-water-fill state).
+    Runs fused always; when the backend has >1 device the same
+    workload repeats on the mesh-sharded engine with the same gate."""
+    import jax
+
+    from ray_tpu.common.ids import NodeID
+    from ray_tpu.common.resources import NodeResources, ResourceRequest
+    from ray_tpu.leasing.board import BudgetBoard
+    from ray_tpu.ops.shard_reduce import resolve_shards
+    from ray_tpu.scheduling import (ClusterResourceManager, DeltaScheduler,
+                                    ShardedDeltaScheduler,
+                                    schedule_grouped_oracle)
+    from ray_tpu.scheduling.contract import compute_budgets
+
+    def one_engine(n_shards: int) -> dict:
+        rng = np.random.default_rng(seed)
+        crm = ClusterResourceManager(capacity=n_nodes)
+        for _ in range(n_nodes):
+            crm.add_node(NodeID.from_random(), NodeResources(
+                {"CPU": int(rng.integers(4, 64)),
+                 "memory": int(rng.integers(8, 256))}))
+        class_reqs = [ResourceRequest(
+            {"CPU": int(rng.integers(1, 4)),
+             "memory": float(rng.integers(0, 8))})
+            for _ in range(n_classes)]
+        vecs = np.stack([crm.intern_request(r) for r in class_reqs])
+        counts = rng.integers(1, 40, size=n_classes).astype(np.int32)
+        eng = ShardedDeltaScheduler(crm, n_shards) if n_shards > 1 \
+            else DeltaScheduler(crm)
+        board = BudgetBoard()
+        churn_req = ResourceRequest({"CPU": 1})
+        debts: list[int] = []
+        eng.beat(vecs, counts)              # beat 1: the full sync
+        per_beat = []
+        for _ in range(beats):
+            for _ in range(churn):
+                if debts and rng.random() < 0.5:
+                    crm.add_back(debts.pop(), churn_req)
+                else:
+                    row = int(rng.integers(0, n_nodes))
+                    crm.force_subtract(row, churn_req)
+                    debts.append(row)
+            t0 = time.perf_counter()
+            eng.beat(vecs, counts)
+            budgets = eng.last_budgets()
+            board.publish(eng.budget_seq,
+                          {str(i): budgets[i] for i in range(n_classes)})
+            per_beat.append((time.perf_counter() - t0) * 1e3)
+        st = crm.snapshot()
+        schedule_grouped_oracle(st, vecs, counts)
+        want = compute_budgets(st.totals, st.avail, vecs,
+                               node_mask=st.node_mask)
+        parity = all(
+            np.array_equal(eng.budget_row_host(v), want[i])
+            for i, v in enumerate(vecs))
+        return {
+            "workload": f"{n_nodes} nodes x {n_classes} classes, "
+                        f"{churn} dirty rows/beat x {beats} beats",
+            "beat_plus_publish_p50_ms":
+                round(float(np.percentile(per_beat, 50)), 3),
+            "budget_parity": parity,
+            "budget_rows_per_beat": n_classes,
+            "nonzero_budget_fraction":
+                round(float((want[:, st.node_mask] > 0).mean()), 4),
+            "board": board.stats(),
+            "shards": eng.stats.get("shards", 1),
+        }
+
+    s = resolve_shards(shards, len(jax.local_devices()))
+    rec: dict = {"fused": one_engine(1),
+                 "sharded": one_engine(s) if s > 1 else None,
+                 # budgets ride the beat's ONE sanctioned fetch: the
+                 # packed (G + C, N+1) buffer (scheduling/policy.py)
+                 "readbacks_per_beat": 1}
+    rec["budget_parity"] = rec["fused"]["budget_parity"] and (
+        rec["sharded"] is None or rec["sharded"]["budget_parity"])
+    return rec
+
+
 def dispatch_lease_bench(num_nodes: int = 10000, jobs: int = 1000,
                          tasks_per_job: int = 16, seed: int = 0,
                          kill_head_at: float | None = 60.0) -> dict:
@@ -339,8 +433,11 @@ def _emit_smoke() -> None:
                                   churn=8)
     dispatch = dispatch_lease_bench(num_nodes=64, jobs=40,
                                     tasks_per_job=8, kill_head_at=None)
+    budget = budget_beat_bench(n_nodes=128, n_classes=16, beats=12,
+                               churn=8)
     ok = delta["oracle_parity"] and \
-        sharded.get("bit_exact_fused_vs_sharded", True)
+        sharded.get("bit_exact_fused_vs_sharded", True) and \
+        budget["budget_parity"]
     print(json.dumps({
         "metric": "delta heartbeat smoke: CPU backend churn workload"
                   + ("" if ok else " [PARITY FAIL]"),
@@ -351,6 +448,7 @@ def _emit_smoke() -> None:
         "delta": delta,
         "sharded": sharded,
         "dispatch": dispatch,
+        "budget_beat": budget,
     }), flush=True)
 
 
@@ -444,7 +542,8 @@ def _cpu_fallback_p50(rounds: int = 5, reps: int = 3) -> float:
 def _emit_skipped(reason: str, cpu_p50: float | None = None,
                   delta: dict | None = None,
                   sharded: dict | None = None,
-                  dispatch: dict | None = None) -> None:
+                  dispatch: dict | None = None,
+                  budget: dict | None = None) -> None:
     """Graceful degradation for tunnel outages: one ``status:skipped``
     JSON line carrying the last-good device number (and the CPU
     fallback measurement when one ran) — instead of the old rc=3
@@ -467,6 +566,7 @@ def _emit_skipped(reason: str, cpu_p50: float | None = None,
         "delta": delta,
         "sharded": sharded,
         "dispatch": dispatch,
+        "budget_beat": budget,
     }), flush=True)
 
 
@@ -556,7 +656,16 @@ def main():
                 print(f"dispatch lease fallback failed: {e!r}",
                       file=sys.stderr)
                 dispatch = None
-            _emit_skipped(reason, cpu_p50, delta, sharded, dispatch)
+            try:
+                # r17: budget emission + parity gate needs no device
+                budget = budget_beat_bench(n_nodes=256, n_classes=24,
+                                           beats=15, churn=16)
+            except Exception as e:   # noqa: BLE001 — record, don't die
+                print(f"budget beat fallback failed: {e!r}",
+                      file=sys.stderr)
+                budget = None
+            _emit_skipped(reason, cpu_p50, delta, sharded, dispatch,
+                          budget)
             return
         time.sleep(20.0)
 
@@ -659,6 +768,11 @@ def main():
         "dispatch": dispatch_lease_bench(num_nodes=10000, jobs=1000,
                                          tasks_per_job=16,
                                          kill_head_at=60.0),
+        # the r17 tentpole surface: budgets riding the beat's single
+        # packed readback + board publish, with the oracle parity gate
+        "budget_beat": budget_beat_bench(n_nodes=N_NODES,
+                                         n_classes=N_CLASSES,
+                                         beats=20, churn=32),
     }))
 
 

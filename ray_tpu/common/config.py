@@ -441,6 +441,22 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Concurrent local admissions a raylet may grant per resource "
         "class from its lease before spilling back to the head; 0 "
         "derives the budget from node capacity."),
+    "lease_budget_source": (
+        str, "beat",
+        "Where the head prices per-class lease budgets: 'beat' reads "
+        "the scheduling beat's device-computed (class x node) headroom "
+        "off the budget board (ray_tpu/leasing/board.py) and falls "
+        "back to the host heuristic when no beat has published for the "
+        "class; 'heuristic' always uses the host-side "
+        "workers x overcommit sizing (the pre-budget-beat behavior). "
+        "An explicit lease_budget_per_class overrides both."),
+    "lease_budget_min": (
+        int, 64,
+        "Floor on any derived per-class lease budget (heuristic or "
+        "beat-emitted): a beat that prices a class at 0 on a node "
+        "still leaves this many admissions so repeat-class pipelines "
+        "stay warm — total local admission is separately bounded by "
+        "capacity x lease_overcommit raylet-side."),
     "lease_max_classes": (
         int, 64,
         "Resource classes a single node's lease snapshot may cover; "

@@ -16,6 +16,10 @@ shared by the live runtime (``runtime/node_agent.py`` +
   and conflicts back, and **self-fences** when head contact is lost for
   the death-declaration horizon (so a revoked epoch can never race a
   fresh local grant past the grace window).
+- :class:`BudgetBoard` — the beat -> grantor seam: the scheduling
+  beat's device-priced per-(class, node) budgets, published by the
+  raylet's delta engine and read by the head when sizing grants
+  (``lease_budget_source = "beat"``).
 
 Both sides are pure state machines over injected timestamps — no clock
 reads, no transport — which is what lets the simulator drive them at
@@ -30,11 +34,13 @@ from __future__ import annotations
 
 import threading
 
+from .board import BudgetBoard, budget_board
 from .grantor import LeaseGrantor
 from .local import LocalLeaseCache
 
-__all__ = ["LeaseGrantor", "LocalLeaseCache", "register_stats",
-           "unregister_stats", "aggregate_stats"]
+__all__ = ["BudgetBoard", "LeaseGrantor", "LocalLeaseCache",
+           "budget_board", "register_stats", "unregister_stats",
+           "aggregate_stats"]
 
 _STATS_LOCK = threading.Lock()
 _STATS_SOURCES: dict[str, object] = {}

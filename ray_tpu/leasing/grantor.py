@@ -30,6 +30,12 @@ class LeaseGrantor:
         # class_key -> [holder nodes, insertion order]; rr cursor per class
         self._class_nodes: dict[str, list[str]] = {}
         self._class_rr: dict[str, int] = {}
+        # epoch each node's grant set was last stamped under: origin_for
+        # must not route to a holder whose epoch was bumped by revoke/
+        # drop_node after its last grant — its raylet will fence every
+        # admission and spill the whole batch back (the one-cycle
+        # spillback storm).  A fresh grant() re-stamps and re-admits.
+        self._granted_epoch: dict[str, int] = {}
         self.leases_issued = 0
         self.revocations = 0
 
@@ -51,6 +57,7 @@ class LeaseGrantor:
                 holders.append(node)
             self.leases_issued += 1
         grants[class_key] = int(budget or self.budget_per_class)
+        self._granted_epoch[node] = self._epochs.get(node, 0)
         return self._epochs.get(node, 0), dict(grants)
 
     def snapshot_for(self, node: str) -> tuple[int, dict]:
@@ -75,6 +82,7 @@ class LeaseGrantor:
         epoch = self.revoke(node, reason)
         for class_key in self._grants.pop(node, {}):
             self._unlink(class_key, node)
+        self._granted_epoch.pop(node, None)
         return epoch
 
     def restore(self, epochs: dict) -> None:
@@ -96,7 +104,13 @@ class LeaseGrantor:
     def origin_for(self, class_key: str, eligible=None) -> str | None:
         """A node already holding a lease for ``class_key`` (round-robin
         over holders, filtered by ``eligible``), or None — the caller
-        falls back to global scheduling and grants the class there."""
+        falls back to global scheduling and grants the class there.
+
+        Holders whose epoch was bumped since their last grant (revoked
+        but not yet re-granted) are skipped: their grant set is fenced
+        raylet-side, so routing repeat-class traffic there can only
+        spill back.  They rejoin the rotation on the next ``grant``.
+        """
         holders = self._class_nodes.get(class_key)
         if not holders:
             return None
@@ -104,6 +118,8 @@ class LeaseGrantor:
         n = len(holders)
         for off in range(n):
             node = holders[(rr + off) % n]
+            if self._epochs.get(node, 0) > self._granted_epoch.get(node, -1):
+                continue        # revoked since last grant: fenced
             if eligible is None or eligible(node):
                 self._class_rr[class_key] = (rr + off + 1) % n
                 return node

@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..scheduling.contract import AVAIL_SHIFT, SCALE, SCORE_SHIFT
+from ..scheduling.contract import AVAIL_SHIFT, BUDGET_CAP, SCALE, SCORE_SHIFT
 
 # Python ints (folded into the program as literals), NOT jnp scalars: a
 # closure-captured device buffer — even a scalar — drops the axon TPU
@@ -379,12 +379,18 @@ def fused_beat(totals, avail, mask, keys, reqs, class_slots, group_counts,
     overrides (the raylet's planned-load debits), an extra soft mask
     (suspect avoidance), the grouped water-fill, and the per-class argmin
     of the carried key tensor — everything the host needs comes back in
-    ONE counts readback per beat, not one per class.
+    ONE counts readback per beat, not one per class.  The water-fill's
+    final carry (post-beat avail) is NOT discarded: it prices the
+    per-(class, node) lease budgets (contract.compute_budgets device
+    twin) that ride the same readback, so the lease plane's admission
+    quotas are the device's own leftover headroom, for free.
 
     class_slots: (G,) int32 slots into ``reqs``.  ov_idx/ov_avail:
     (B,) int32 rows + (B, R) int32 replacement avail rows applied for
     this beat only (padding idx == N; the resident mirror is untouched).
-    Returns (counts (G, N+1) int32, argmin_rows (C,) int32)."""
+    Returns (packed (G + C, N+1) int32 — rows [:G] are the water-fill
+    counts with the overflow column, rows [G:] the per-class lease
+    budgets (zero overflow column) — and argmin_rows (C,) int32)."""
     avail_eff = avail.at[ov_idx].set(ov_avail, mode="drop")
     mask_eff = mask & extra_mask
     group_reqs = reqs[jnp.clip(class_slots, 0, reqs.shape[0] - 1)]
@@ -397,9 +403,29 @@ def fused_beat(totals, avail, mask, keys, reqs, class_slots, group_counts,
                                       ones, thr_fp, require_available)
         return new_av, row
 
-    _, counts = jax.lax.scan(step, avail_eff, (group_reqs, group_counts))
+    av_fin, counts = jax.lax.scan(step, avail_eff, (group_reqs, group_counts))
+
+    # Lease budgets off the post-beat avail.  Clamp >= 0 before the floor
+    # division (contract: numpy and XLA disagree on negative ``//``), and
+    # price EVERY resident class, not just this beat's active groups —
+    # idle repeat classes are exactly the ones the lease plane admits
+    # raylet-side without asking the head.
+    av_nn = jnp.maximum(av_fin, 0)
+
+    def budget_row(req):
+        pos = req > 0
+        feas = jnp.all(jnp.where(pos[None, :], totals >= req[None, :], True),
+                       axis=1) & mask_eff
+        fill = jnp.where(pos[None, :],
+                         av_nn // jnp.maximum(req, 1)[None, :],
+                         BUDGET_CAP).min(axis=1, initial=BUDGET_CAP)
+        return jnp.where(feas, jnp.clip(fill, 0, BUDGET_CAP), 0)
+
+    budgets = jax.vmap(budget_row)(reqs).astype(jnp.int32)          # (C, N)
+    packed = jnp.concatenate(
+        [counts, jnp.pad(budgets, ((0, 0), (0, 1)))], axis=0)       # +1 col
     amin = jnp.argmin(keys, axis=1).astype(jnp.int32)
-    return counts, amin
+    return packed, amin
 
 
 def schedule_grouped_np(totals, avail, node_mask, group_reqs, group_counts,

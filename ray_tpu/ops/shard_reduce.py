@@ -41,8 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..scheduling.contract import (AVAIL_SHIFT, MAX_NODES, SCALE,
-                                   SCORE_SHIFT)
+from ..scheduling.contract import (AVAIL_SHIFT, BUDGET_CAP, MAX_NODES,
+                                   SCALE, SCORE_SHIFT)
 from ..util.jax_compat import shard_map_compat
 
 # Python ints folded as literals — NOT jnp scalars (a closure-captured
@@ -380,11 +380,16 @@ class ShardPlane:
                    require_available=False):
         """One sharded heartbeat: per-shard ephemeral overrides + soft
         mask, the G-class water-fill scan with two-level collectives,
-        and the carried-key argmin via the ICI->DCN pmin reduce.
+        and the carried-key argmin via the ICI->DCN pmin reduce.  Each
+        shard also prices its own rows' per-(class, node) lease budgets
+        from the scan's final avail carry (contract.compute_budgets
+        twin) — a purely node-local map, so sharding it is exact.
 
-        Returns (counts (G, N+1) int32 REPLICATED, amin (C,) int32
-        replicated) — the host's single counts fetch reads one buffer,
-        the cross-device gather happened on the interconnect."""
+        Returns (packed (G + C, N+1) int32 REPLICATED — rows [:G] the
+        water-fill counts + overflow column, rows [G:] the lease
+        budgets — and amin (C,) int32 replicated); the host's single
+        fetch reads one buffer, the cross-device gather happened on the
+        interconnect."""
         key = bool(require_available)
         if key not in self._fused:
             P = self._P
@@ -409,13 +414,32 @@ class ShardPlane:
                         my_lin, n_lin, req_av)
                     return new_av_l, (row_l, inf_c)
 
-                _, (alloc, inf) = jax.lax.scan(
+                av_fin, (alloc, inf) = jax.lax.scan(
                     step, a_eff, (group_reqs, counts))
+
+                # shard-local lease budgets off the post-beat avail
+                # (clamped >= 0 before ``//`` — contract.compute_budgets)
+                av_nn = jnp.maximum(av_fin, 0)
+
+                def budget_row(req):
+                    pos = req > 0
+                    feas = jnp.all(
+                        jnp.where(pos[None, :], t_l >= req[None, :],
+                                  True), axis=1) & m_eff
+                    fill = jnp.where(
+                        pos[None, :],
+                        av_nn // jnp.maximum(req, 1)[None, :],
+                        BUDGET_CAP).min(axis=1, initial=BUDGET_CAP)
+                    return jnp.where(feas,
+                                     jnp.clip(fill, 0, BUDGET_CAP), 0)
+
+                budgets_l = jax.vmap(budget_row)(reqs).astype(
+                    jnp.int32)                           # (C, n_local)
                 lmin = k_l.min(axis=1, initial=_INF_KEY)     # (C,)
                 gmin = _pmin2(lmin)
                 amin = jnp.where(gmin == _INF_KEY, 0,
                                  gmin & _IDX_MASK).astype(jnp.int32)
-                return alloc, inf, amin
+                return alloc, inf, budgets_l, amin
 
             smapped = self._smap(
                 body, mesh=self.mesh,
@@ -426,14 +450,16 @@ class ShardPlane:
                           P(("dcn", "ici")),
                           P(("dcn", "ici")),
                           P(("dcn", "ici"), None), P()),
-                out_specs=(P(None, ("dcn", "ici")), P(), P()))
+                out_specs=(P(None, ("dcn", "ici")), P(),
+                           P(None, ("dcn", "ici")), P()))
 
             def wrapper(t, a, m, k, reqs, slots, counts, em, ovi, ova,
                         thr):
-                alloc, inf, amin = smapped(t, a, m, k, reqs, slots,
-                                           counts, em, ovi, ova, thr)
+                alloc, inf, budgets, amin = smapped(
+                    t, a, m, k, reqs, slots, counts, em, ovi, ova, thr)
                 return (jnp.concatenate(
-                    [alloc, inf[:, None]], axis=1), amin)
+                    [jnp.concatenate([alloc, inf[:, None]], axis=1),
+                     jnp.pad(budgets, ((0, 0), (0, 1)))], axis=0), amin)
 
             self._fused[key] = jax.jit(
                 wrapper,

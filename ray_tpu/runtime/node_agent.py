@@ -1558,11 +1558,20 @@ class AgentHub:
         self._grantor = None
         self._cfg_budget = int(cfg.lease_budget_per_class)
         self._lease_overcommit = float(cfg.lease_overcommit)
+        # budget sizing knobs: an explicit lease_budget_per_class wins;
+        # otherwise 'beat' reads the scheduling beat's device-priced
+        # headroom off the budget board (host heuristic as fallback),
+        # 'heuristic' is the pre-budget-beat workers x overcommit path
+        self._budget_min = max(1, int(cfg.lease_budget_min))
+        self._board = None
+        if str(cfg.lease_budget_source) == "beat" and not self._cfg_budget:
+            from ..leasing.board import budget_board
+            self._board = budget_board()
         self._epoch_tab: dict[str, int] = {}
         if cfg.lease_plane_enabled:
             from ..leasing import LeaseGrantor, register_stats
             self._grantor = LeaseGrantor(
-                budget_per_class=self._cfg_budget or 64,
+                budget_per_class=self._cfg_budget or self._budget_min,
                 max_classes=int(cfg.lease_max_classes),
                 journal=self._journal_epoch)
             self._restore_epochs()
@@ -1739,10 +1748,19 @@ class AgentHub:
         raylet.agent_local_cu = dict(load) if load else None
         raylet._notify_dirty()
         if self._grantor is not None:
-            budget = self._cfg_budget or max(
-                64, int(self._agent_workers.get(agent_id, 2) *
-                        self._lease_overcommit))
+            fallback = self._cfg_budget or max(
+                self._budget_min,
+                int(self._agent_workers.get(agent_id, 2) *
+                    self._lease_overcommit))
             for ck in list(lease_want or ())[:32]:
+                budget = fallback
+                if self._board is not None:
+                    # the beat's device-priced headroom for this
+                    # (class, node); floored so repeat-class pipelines
+                    # stay warm even when the beat prices a node at 0
+                    b = self._board.budget_for(str(ck), row)
+                    if b is not None:
+                        budget = max(self._budget_min, int(b))
                 self._grantor.grant(agent_id, str(ck), budget)
             ep, grants = self._grantor.snapshot_for(agent_id)
             return {"ok": True, "epoch": ep, "grants": grants}

@@ -763,8 +763,35 @@ class Raylet:
             base = avail_f[row].astype(np.int64)
             base[:w] = (base[:w] - planned[:w]).clip(-(2**30), 2**30)
             overrides[row] = base.astype(np.int32)
-        return eng.beat(req_arr, cnt_arr, overrides=overrides,
-                        extra_mask=extra)
+        counts = eng.beat(req_arr, cnt_arr, overrides=overrides,
+                          extra_mask=extra)
+        cfg = get_config()
+        if cfg.lease_plane_enabled and cfg.lease_budget_source == "beat":
+            self._publish_beat_budgets(eng)
+        return counts
+
+    def _publish_beat_budgets(self, eng) -> None:
+        """Hand the beat's device-priced (class x node) lease budgets —
+        already host-side, they rode the beat's single readback — to
+        the process-wide budget board the head's ``AgentHub`` sizes
+        grants from (the closed dispatch loop: beat -> readback ->
+        grantor -> raylet lease cache).  Budget rows are re-keyed from
+        interned request vectors to the lease plane's class-key strings
+        (``node_agent._lease_class_key`` format)."""
+        from ..leasing.board import budget_board
+        budgets = eng.last_budgets()
+        if budgets is None:
+            return
+        index = self.crm.resource_index
+        rows: dict[str, np.ndarray] = {}
+        for slot, vec in eng.class_vectors().items():
+            if slot >= budgets.shape[0]:
+                continue        # interned after the beat; next beat has it
+            parts = sorted((index.name(int(c)), int(vec[c]))
+                           for c in np.flatnonzero(vec))
+            ck = ",".join(f"{k}:{v}" for k, v in parts) or "zero"
+            rows[ck] = budgets[slot]
+        budget_board().publish(eng.budget_seq, rows)
 
     def _schedule_device_topk(self, totals, avail, mask, req_arr,
                               cnt_arr, gmask, pref_arr,

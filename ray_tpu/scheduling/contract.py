@@ -63,6 +63,11 @@ SCORE_SHIFT = NODE_BITS
 AVAIL_SHIFT = NODE_BITS + 14           # eff(n) <= 2*SCALE < 2**14
 INFEASIBLE_KEY = np.int32(2**31 - 1)
 MAX_SCORE = 2 * SCALE                  # score of a node at 2x utilization
+# Per-(class, node) lease-budget ceiling: the fused beat emits water-fill
+# headroom as lease budgets (see compute_budgets); the cap bounds what a
+# single grant can hand a raylet and keeps the packed budget tensor well
+# inside int32 (avail <= MAX_TOTAL_CU = 2**17, req >= 1 cu).
+BUDGET_CAP = 1 << 15
 
 
 def threshold_fp(spread_threshold: float | None = None) -> int:
@@ -124,6 +129,46 @@ def compute_keys_batch(totals: np.ndarray, avail: np.ndarray,
     reqs = np.asarray(reqs, dtype=np.int64)
     return np.stack([compute_keys(totals, avail, r, thr_fp, node_mask)
                      for r in reqs])
+
+
+def compute_budgets(totals: np.ndarray, avail: np.ndarray, reqs: np.ndarray,
+                    node_mask: np.ndarray | None = None,
+                    cap: int = BUDGET_CAP) -> np.ndarray:
+    """Per-(class, node) lease budgets from a post-water-fill state.
+
+    The host oracle twin of the budget tensor the fused beat emits
+    (``ops.hybrid_kernel.fused_beat`` / ``ShardPlane.fused_beat``): for
+    each class ``c`` and node ``n``, how many MORE tasks of ``c`` node
+    ``n`` could admit against the availables the beat left behind.
+
+    * feasible(c, n) = all(T_n[i] >= r_c[i] for r_c[i] > 0) and mask(n)
+    * fill(c, n)     = min over {i : r_c[i] > 0} of max(A_n[i], 0) // r_c[i]
+                       (``cap`` when the class requests nothing — the
+                       "zero" lease class is admission-unbounded)
+    * budget(c, n)   = clip(fill, 0, cap) if feasible else 0
+
+    ``avail`` is clamped to >= 0 *before* the floor division on both the
+    host and device twins — numpy and XLA agree on non-negative ``//``
+    but not on negative operands, and overcommitted rows owe 0 headroom
+    anyway.  totals/avail: (N, R) int32 cu; reqs: (C, R); returns (C, N)
+    int32.
+    """
+    totals = np.asarray(totals, dtype=np.int64)
+    avail = np.maximum(np.asarray(avail, dtype=np.int64), 0)
+    reqs = np.atleast_2d(np.asarray(reqs, dtype=np.int64))
+    n = totals.shape[0]
+    mask = (np.ones(n, dtype=bool) if node_mask is None
+            else np.asarray(node_mask, dtype=bool))
+    out = np.zeros((reqs.shape[0], n), dtype=np.int32)
+    for c, r in enumerate(reqs):
+        pos = r > 0
+        if not pos.any():
+            out[c] = np.where(mask, np.int32(cap), np.int32(0))
+            continue
+        feas = (totals[:, pos] >= r[pos]).all(axis=1) & mask
+        fill = (avail[:, pos] // r[pos]).min(axis=1)
+        out[c] = np.where(feas, np.clip(fill, 0, cap), 0).astype(np.int32)
+    return out
 
 
 def unpack_key(key: int) -> tuple[int, int, int]:

@@ -241,7 +241,12 @@ class DeltaScheduler:
     3. runs the fused water-fill + per-class argmin
        (``ops.hybrid_kernel.fused_beat``) with this beat's ephemeral
        avail overrides (planned-load debits) and soft mask (suspect
-       avoidance) — ONE counts readback per beat, not one per class.
+       avoidance) — ONE readback per beat, not one per class.  The
+       packed buffer carries the water-fill counts AND the
+       per-(class, node) lease budgets the kernel priced off its own
+       post-beat avail (``contract.compute_budgets`` twin); the lease
+       plane reads them via ``last_budgets``/``budget_row_host``
+       without any extra device sync.
 
     Placements are advisory exactly like the snapshot path: the CRM
     stays authoritative, commits happen through ``subtract`` at
@@ -278,6 +283,11 @@ class DeltaScheduler:
         self._parity = 0
         self._empty_ov = None
         self._last_amin = None
+        # beat-emitted lease budgets: host (C_real, n_real) slice of the
+        # packed readback, refreshed every beat; seq lets the publisher
+        # tell "new beat" from "same beat re-read"
+        self._budgets_host: np.ndarray | None = None
+        self._budget_seq = 0
         self.stats = {"beats": 0, "delta_beats": 0, "full_rescores": 0,
                       "clean_beats": 0, "rows_uploaded": 0,
                       "classes_installed": 0}
@@ -363,8 +373,12 @@ class DeltaScheduler:
             counts_d.block_until_ready()    # rtlint: disable=W6
             self.phase_ms["argmin"] += (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
-        # the one sanctioned host<-device readback of the beat
-        counts = np.asarray(counts_d)
+        # the one sanctioned host<-device readback of the beat: rows
+        # [:gp] are the water-fill counts, rows [gp:] the lease budgets
+        packed = np.asarray(counts_d)
+        counts = packed[:gp]
+        self._budgets_host = packed[gp:, :n_real]
+        self._budget_seq += 1
         if self.profile:
             self.phase_ms["readback"] += (time.perf_counter() - t0) * 1e3
         return np.concatenate(
@@ -402,6 +416,36 @@ class DeltaScheduler:
         key = np.ascontiguousarray(
             np.asarray(req_vec, np.int32)).tobytes()
         return int(np.asarray(self._last_amin)[self._slot_of[key]])
+
+    # -- beat-emitted lease budgets (host copies off the fused readback) ----
+    @property
+    def budget_seq(self) -> int:
+        """Monotonic count of beats whose budgets have landed."""
+        return self._budget_seq
+
+    def last_budgets(self) -> np.ndarray | None:
+        """(C, n_real) int32 budgets from the last beat's readback, row
+        index == class slot; None before the first beat.  NOT a device
+        sync — this is the host slice the beat already fetched."""
+        return self._budgets_host
+
+    def class_vectors(self) -> dict[int, np.ndarray]:
+        """{slot: interned dense request vector} for every resident
+        class — the publisher's map from budget rows back to lease
+        class keys."""
+        return dict(self._class_host)
+
+    def budget_row_host(self, req_vec) -> np.ndarray | None:
+        """Beat-emitted lease budget of one interned class vs the real
+        nodes, or None if the class isn't resident / no beat has run."""
+        if self._budgets_host is None:
+            return None
+        key = np.ascontiguousarray(
+            np.asarray(req_vec, np.int32)).tobytes()
+        slot = self._slot_of.get(key)
+        if slot is None or slot >= self._budgets_host.shape[0]:
+            return None
+        return self._budgets_host[slot]
 
     # -- device-layout hooks (the mesh-sharded engine overrides these) ------
     def _put_extra_mask(self, emp):

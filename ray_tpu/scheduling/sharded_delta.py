@@ -9,7 +9,11 @@ heartbeat — the host stages per-shard upload buckets and the
 double-buffered transfer to each device carries nothing another device
 owns.  Global decisions (water-fill levels, the placement argmin) lower
 to two-level collectives: psum/pmin over ICI within a slice, then DCN
-across slices.  The beat still performs exactly ONE counts readback.
+across slices.  The beat still performs exactly ONE readback — a packed
+buffer carrying both the water-fill counts and the per-(class, node)
+lease budgets each shard priced from its own rows' post-beat avail
+(a node-local map, so sharding it is exact; see
+``ShardPlane.fused_beat``).
 
 The aggregate mesh HBM — not one chip — now bounds the (classes x
 nodes) problem: per-device resident bytes shrink by ~S, so an S-way

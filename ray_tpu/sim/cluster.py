@@ -108,6 +108,12 @@ class SimParams:
     lease_plane: bool = False
     lease_overcommit: float = 2.0
     lease_max_classes: int = 64
+    # floor on the per-class budget the sim head carves (the
+    # lease_budget_min knob's sim twin); 0 = no floor, the pre-r17
+    # capacity x overcommit sizing — the dataclass default keeps
+    # directly-constructed campaigns (dispatch_bench, hunts) replaying
+    # their recorded trace hashes unchanged
+    lease_budget_min: int = 0
     standby: bool = False
     standby_quorum: float = 0.34
     # planted canary bug (r16, default off): the hunt's CI smoke and
@@ -130,6 +136,7 @@ class SimParams:
             lease_plane=cfg.sim_lease_plane,
             lease_overcommit=cfg.lease_overcommit,
             lease_max_classes=cfg.lease_max_classes,
+            lease_budget_min=cfg.lease_budget_min,
             standby=cfg.sim_standby,
             standby_quorum=cfg.standby_quorum,
         )
@@ -445,8 +452,10 @@ class SimHead:
             # fill a node without artificial per-class throttling — the
             # raylet's admitted_total cap enforces the real limit
             self.grantor = LeaseGrantor(
-                budget_per_class=int(self.params.node_capacity *
-                                     self.params.lease_overcommit),
+                budget_per_class=max(
+                    int(self.params.node_capacity *
+                        self.params.lease_overcommit),
+                    self.params.lease_budget_min),
                 max_classes=self.params.lease_max_classes,
                 journal=_journal)
         handlers = {

@@ -58,6 +58,13 @@ The invariants, and the machinery each one proves:
   strictly increase, across head kills and standby promotions: a
   promoted head that re-issued a journaled epoch would break the
   at-most-once execution fence.
+- **budget-conservation** (r17) — with the lease plane on, a raylet's
+  locally-admitted count for a class never exceeds the budget the head
+  emitted for it under the node's current epoch (the closed dispatch
+  loop: budgets priced by the scheduling beat must bound what the
+  local cache actually admits).  Nodes mid-revocation (cache epoch
+  behind the grantor's) and classes the grantor LRU-evicted (eviction
+  does not bump the epoch) are out of scope.
 """
 
 from __future__ import annotations
@@ -88,6 +95,8 @@ INVARIANTS = {
     "revocation-epoch-monotonic": "revocation epochs strictly increase",
     "bcast-wave-terminal": "strict final: every wave reaches terminal",
     "bcast-live-replica": "strict final: live wave members hold replicas",
+    "budget-conservation":
+        "locally-admitted grants never exceed head-emitted budgets",
 }
 
 _NAME_RE = re.compile(r"\[inv:([a-z0-9-]+) @t=")
@@ -233,6 +242,38 @@ def _check_epoch_monotonic(cluster, now: float) -> tuple[list[str], int]:
     return violations, checks
 
 
+def _check_budget_conservation(cluster, head, now: float
+                               ) -> tuple[list[str], int]:
+    """budget-conservation: for every alive lease-plane node whose
+    cache epoch matches the grantor's, each class's locally-admitted
+    count is bounded by the head-emitted budget.  Classes the grantor
+    LRU-evicted (eviction never bumps the epoch) are skipped — the node
+    may legitimately drain admissions the head no longer tracks."""
+    violations: list[str] = []
+    checks = 0
+    grantor = head.grantor
+    if grantor is None:
+        return violations, checks
+    for nid, node in cluster.nodes.items():
+        lease = getattr(node, "lease", None)
+        if lease is None or not node.alive:
+            continue
+        epoch, grants = grantor.snapshot_for(nid)
+        if lease.epoch != epoch:
+            continue    # revocation in flight: discard underway
+        for ck, entry in lease._classes.items():
+            emitted = grants.get(ck)
+            if emitted is None:
+                continue
+            checks += 1
+            if entry[1] > emitted:
+                violations.append(fmt_violation(
+                    "budget-conservation", now,
+                    f"{nid} admitted {entry[1]} of class {ck} against "
+                    f"head-emitted budget {emitted} (epoch {epoch})"))
+    return violations, checks
+
+
 def check_invariants(cluster, acked_jobs, strict: bool = False
                      ) -> tuple[list[str], int]:
     """Run every invariant; returns (violations, predicates_evaluated).
@@ -316,6 +357,12 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
         cv, cn = _check_object_copies(head, now)
         violations.extend(cv)
         checks += cn
+        # budget-conservation: local admissions bounded by emitted
+        # budgets (needs the live head's grantor book)
+        if p.lease_plane and getattr(head, "grantor", None) is not None:
+            gv, gn = _check_budget_conservation(cluster, head, now)
+            violations.extend(gv)
+            checks += gn
 
     # serve plane (when a serve_diurnal campaign installed one)
     plane = getattr(cluster, "serve_plane", None)

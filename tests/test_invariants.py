@@ -183,6 +183,18 @@ def _revocation_epoch_monotonic(c, acked):
     c.revocation_log["n00001"] = [(3, 1.0), (3, 2.0)]
 
 
+def _budget_conservation(c, acked):
+    # head emits a budget of 2 for the class, then the node's cache
+    # claims to have admitted 3 under the same epoch
+    head = c.head
+    nid = "n00001"
+    node = c.nodes[nid]
+    ep = head.grantor.epoch(nid)
+    head.grantor.grant(nid, "CPU:100", 2)
+    node.lease.install({"CPU:100": 2}, ep)
+    node.lease._classes["CPU:100"][1] = 3
+
+
 def _bcast_wave_terminal(c, acked):
     # strict final with the in-flight wave still not terminal
     pass
@@ -220,6 +232,7 @@ CORRUPTIONS = {
     "revocation-epoch-monotonic": (_revocation_epoch_monotonic, False),
     "bcast-wave-terminal": (_bcast_wave_terminal, True),
     "bcast-live-replica": (_bcast_live_replica, True),
+    "budget-conservation": (_budget_conservation, False),
 }
 
 

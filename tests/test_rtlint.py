@@ -858,3 +858,29 @@ class TestW6:
             files=[os.path.join(REPO_ROOT, m) for m in new_modules])
         assert [f for f in findings if f.rule != "E0"] == [], \
             "hunt/minimize must stay clock- and sync-free"
+
+    def test_budget_beat_modules_in_w5_w6_scope_with_zero_baseline(self):
+        """The r17 budget-emission seam — the CPU oracle twin in
+        contract.py and the beat->grantor board — is inside W6's
+        device-sync scope, the board additionally inside W5's
+        clock-seam scope (leasing/ prefix), and contributes zero
+        grandfathered baseline entries: budgets ride the beat's one
+        sanctioned readback, they never add a sync or a clock read."""
+        from tools.rtlint import rules_device, rules_time
+        board = "ray_tpu/leasing/board.py"
+        contract = "ray_tpu/scheduling/contract.py"
+        for mod in (board, contract):
+            assert os.path.exists(os.path.join(REPO_ROOT, mod))
+            assert any(mod.startswith(sc) for sc in rules_device._SCOPES)
+        assert any(board.startswith(sc) for sc in rules_time._SCOPES)
+        accepted = baseline_mod.load(os.path.join(
+            REPO_ROOT, "tools", "rtlint", "baseline.json"))
+        for key in accepted:
+            assert board not in key and contract not in key, \
+                f"grandfathered finding in a budget module: {key}"
+        # live, not vacuous: both pass W5+W6 as they stand
+        findings = analyzer.run_analysis(
+            REPO_ROOT, package="ray_tpu", rules=("W5", "W6"),
+            files=[os.path.join(REPO_ROOT, m) for m in (board, contract)])
+        assert [f for f in findings if f.rule != "E0"] == [], \
+            "budget seam must stay clock- and sync-free"
