@@ -1,15 +1,26 @@
-"""Serve request-plane benchmark: diurnal scale, batching, shedding.
+"""Serve request-plane benchmark: diurnal scale, rolling updates,
+batching, shedding.
 
-Three experiments, mirroring bench.py's smoke-first discipline (a JSON
+Four experiments, mirroring bench.py's smoke-first discipline (a JSON
 record always lands, even if the live cluster hangs):
 
-- **diurnal** (the smoke stage, disposable subprocess): the 1k-node
+- **diurnal** (smoke stage, disposable subprocess): the 1k-node
   simulated ``serve_diurnal`` campaign — a cosine day/night arrival
   curve with chaos faults — run twice, single-router vs 8-sharded
   routers, same seed.  The SLO report checks the sharding bar (sharded
   accepted QPS >= 3x single at equal-or-better p99), zero
   accepted-request loss, and that elastic capacity loans fired and
-  reclaimed in well under a cold boot.  Written to ``SERVE_r10.json``.
+  reclaimed in well under a cold boot.  Written to ``SERVE_r18.json``.
+- **rolling** (smoke stage): the 1k-node ``serve_rolling_update``
+  campaign fires a weight rollout at t=75s — the diurnal peak — and
+  must SEAL it: every replica flipped, zero accepted-request loss,
+  run-level p99 no worse than 1.25x a control run without the rollout,
+  no mixed-version session, and the whole run replays bit-identically.
+- **rolling live**: a 16-replica deployment hot-swapped via
+  ``versioning.rollout`` under closed-loop traffic (0 drops required,
+  per-replica flip downtime under one health-probe period) against a
+  cold restart (delete + redeploy) of the same deployment, which drops
+  every in-flight and boot-window request.
 - **batching**: a model that admits ONE inference stream (a lock around
   a fixed ~8 ms compute step) served unbatched vs through
   ``@serve.batch`` — the batcher amortizes the per-invocation cost
@@ -21,7 +32,7 @@ record always lands, even if the live cluster hangs):
   ACCEPTED requests stays bounded by queue depth, not by offered load.
 
 Prints one JSON line per stage (smoke, then the live headline) and
-writes the full round record to ``SERVE_r10.json``.
+writes the full round record to ``SERVE_r18.json``.
 """
 
 import json
@@ -40,9 +51,12 @@ SIM_SEED = 3
 SIM_FAULTS = 12
 SIM_DURATION = 150.0
 SHARD_CONFIGS = (1, 8)
+ROLL_T = 75.0           # rollout start: the diurnal peak
+ROLL_FAULTS = 1         # chaos alongside the mid-peak rollout
+ROLL_REPLICAS = 16      # live hot-swap deployment size
 
 RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "SERVE_r10.json")
+                      "SERVE_r18.json")
 
 
 # -- diurnal sim campaign (the smoke stage) -----------------------------------
@@ -87,28 +101,81 @@ def diurnal_bench() -> dict:
     }
 
 
+def rolling_sim_bench() -> dict:
+    """1k-node ``serve_rolling_update`` campaign with the rollout fired
+    mid-peak, run twice (bit-identical replay) plus a no-rollout
+    control run for the p99-flat comparison."""
+    from ray_tpu.sim import run_campaign
+    from ray_tpu.versioning import phases
+
+    sched = [(ROLL_T, "rollout",
+              {"artifact": "w-r18", "probe_fail_at": -1})]
+    kw = dict(seed=SIM_SEED, campaign="serve_rolling_update",
+              faults=ROLL_FAULTS, duration=SIM_DURATION)
+    r1 = run_campaign(SIM_NODES, schedule=sched, **kw)
+    r2 = run_campaign(SIM_NODES, schedule=sched, **kw)
+    ctl = run_campaign(SIM_NODES, schedule=[], **kw)
+    assert r1.ok and ctl.ok, (r1.violations, ctl.violations)
+
+    ro = r1.stats["rollout"]["per_rollout"][0]
+    sv, cv = r1.stats["serve"], ctl.stats["serve"]
+    slo = {
+        "sealed_mid_peak": (ro["phase"] == phases.SEALED
+                            and 0 < ro["flipped"] == ro["replicas"]),
+        "zero_accepted_loss": (sv["accepted"] == sv["completed"]
+                               and sv["outstanding"] == 0),
+        # run-level p99 against the no-rollout control (the latency
+        # histogram quantizes to bucket edges, so the during-flip
+        # delta cannot resolve ratios under 1.5x — the run-level
+        # figure can, and must stay flat)
+        "p99_flat": sv["p99_s"] <= 1.25 * cv["p99_s"],
+        "replay_bit_identical": r1.trace_hash == r2.trace_hash,
+        "no_mixed_version_session":
+            r1.stats["rollout"]["mixed_served"] == 0,
+    }
+    return {
+        "nodes": SIM_NODES, "seed": SIM_SEED, "faults": ROLL_FAULTS,
+        "duration_s": SIM_DURATION, "rollout_at_s": ROLL_T,
+        "rollout": {k: ro[k] for k in
+                    ("phase", "flipped", "replicas", "pre_p99_s",
+                     "during_p99_s", "seconds", "error")},
+        "pin_migrations": r1.stats["rollout"]["migrations"],
+        "p99_s": sv["p99_s"], "control_p99_s": cv["p99_s"],
+        "accepted": sv["accepted"], "completed": sv["completed"],
+        "trace_hash": r1.trace_hash,
+        "slo": slo, "slo_pass": all(slo.values()),
+    }
+
+
 def _emit_smoke() -> None:
-    """The --smoke entry: run the diurnal pair in this disposable
-    subprocess and print exactly one JSON line."""
+    """The --smoke entry: run the diurnal pair and the rolling-update
+    campaign in this disposable subprocess and print exactly one JSON
+    line."""
     d = diurnal_bench()
-    flags = "" if d["slo_pass"] else " [SLO FAIL: " + ", ".join(
-        k for k, v in d["slo"].items() if not v) + "]"
+    r = rolling_sim_bench()
+    bad = ([k for k, v in d["slo"].items() if not v]
+           + [k for k, v in r["slo"].items() if not v])
+    flags = "" if not bad else " [SLO FAIL: " + ", ".join(bad) + "]"
     print(json.dumps({
         "metric": f"serve diurnal 1k-node sim: {SHARD_CONFIGS[-1]}-shard "
                   f"accepted {d['slo']['accepted_qps_gain']}x single-"
                   f"router at p99 {d['sharded_router']['p99_s']}s vs "
-                  f"{d['single_router']['p99_s']}s" + flags,
+                  f"{d['single_router']['p99_s']}s; mid-peak rollout "
+                  f"{r['rollout']['phase']} {r['rollout']['flipped']}/"
+                  f"{r['rollout']['replicas']} at p99 {r['p99_s']}s vs "
+                  f"control {r['control_p99_s']}s" + flags,
         "value": d["slo"]["accepted_qps_gain"],
         "unit": "x",
         "vs_baseline": d["slo"]["accepted_qps_gain"],
         "status": "smoke",
         "diurnal": d,
+        "rolling": r,
     }), flush=True)
 
 
-def _smoke_first() -> dict | None:
-    """Run the diurnal stage in a subprocess (a hung backend cannot eat
-    the record), print its JSON line, and seed SERVE_r10.json so the
+def _smoke_first() -> tuple[dict | None, dict | None]:
+    """Run the sim stages in a subprocess (a hung backend cannot eat
+    the record), print their JSON line, and seed SERVE_r18.json so the
     round's record exists before the live cluster starts."""
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -121,22 +188,23 @@ def _smoke_first() -> dict | None:
         if proc.returncode == 0 and lines:
             print(lines[-1], flush=True)
             record = json.loads(lines[-1])
-            _write_record(record.get("diurnal"), live=None)
-            return record.get("diurnal")
+            _write_record(record.get("diurnal"), record.get("rolling"),
+                          live=None)
+            return record.get("diurnal"), record.get("rolling")
         err = f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
     except subprocess.TimeoutExpired:
         err = "smoke subprocess exceeded 600s"
     print(json.dumps({
-        "metric": f"serve diurnal smoke FAILED [{err}]",
+        "metric": f"serve sim smoke FAILED [{err}]",
         "value": -1.0, "unit": "x", "vs_baseline": 0.0,
         "status": "smoke_failed"}), flush=True)
-    _write_record(None, live=None, error=err)
-    return None
+    _write_record(None, None, live=None, error=err)
+    return None, None
 
 
-def _write_record(diurnal, live, error: str = "") -> None:
-    doc = {"format": "ray_tpu-serve-bench/1", "round": 10,
-           "diurnal": diurnal, "live": live}
+def _write_record(diurnal, rolling, live, error: str = "") -> None:
+    doc = {"format": "ray_tpu-serve-bench/1", "round": 18,
+           "diurnal": diurnal, "rolling": rolling, "live": live}
     if error:
         doc["error"] = error
     with open(RECORD, "w") as f:
@@ -145,6 +213,91 @@ def _write_record(diurnal, live, error: str = "") -> None:
 
 
 # -- live experiments ---------------------------------------------------------
+
+def bench_rolling() -> dict:
+    """Hot-swap a live 16-replica deployment under closed-loop traffic
+    and compare against a cold restart of the same deployment.  The
+    hot swap must drop nothing and keep each replica's out-of-routing
+    window under one health-probe period; the cold restart drops every
+    request that touches the teardown/boot window."""
+    import ray_tpu
+    from ray_tpu import serve, versioning
+    from ray_tpu.common.config import get_config
+    from ray_tpu.versioning import phases
+
+    def _deploy():
+        @serve.deployment(num_replicas=ROLL_REPLICAS)
+        class Model:
+            def __init__(self):
+                self.tag = "cold"
+
+            def __call__(self, x):
+                return self.tag
+
+            def reload(self, artifact):
+                self.tag = bytes(artifact).decode()
+
+        return serve.run(Model.bind())
+
+    def _measure(swap) -> dict:
+        box = [_deploy()]
+        ray_tpu.get([box[0].remote(i) for i in range(32)], timeout=120)
+        stop = threading.Event()
+        drops: list = []
+        served: list = []
+        lock = threading.Lock()
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    r = ray_tpu.get(box[0].remote(0), timeout=30)
+                    with lock:
+                        served.append(r)
+                except Exception as e:  # noqa: BLE001 — count as drop
+                    with lock:
+                        drops.append(type(e).__name__)
+
+        threads = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        extra = swap(box)
+        wall = time.perf_counter() - t0
+        time.sleep(0.5)                 # catch straggler drops
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        serve.delete()
+        return {"swap_wall_s": round(wall, 2), "dropped": len(drops),
+                "served_during": len(served), **extra}
+
+    def hot(box) -> dict:
+        s = versioning.rollout(b"hot-v2", artifact_label="hot-v2")
+        return {"phase": s["phase"], "flipped": s["flipped"],
+                "max_flip_downtime_s": s["max_flip_downtime_s"]}
+
+    def cold(box) -> dict:
+        serve.delete()
+        box[0] = _deploy()
+        return {}
+
+    hot_r = _measure(hot)
+    cold_r = _measure(cold)
+    probe_s = get_config().health_check_period_ms / 1000.0
+    slo = {
+        "hot_sealed": (hot_r.get("phase") == phases.SEALED
+                       and hot_r.get("flipped") == ROLL_REPLICAS),
+        "hot_zero_drops": hot_r["dropped"] == 0,
+        "cold_drops": cold_r["dropped"] > 0,
+        "flip_downtime_under_probe_period":
+            hot_r.get("max_flip_downtime_s", probe_s) < probe_s,
+    }
+    return {"replicas": ROLL_REPLICAS, "hot_swap": hot_r,
+            "cold_restart": cold_r,
+            "health_probe_period_s": probe_s,
+            "slo": slo, "slo_pass": all(slo.values())}
+
 
 def _throughput(handle, n=N_REQUESTS) -> float:
     import ray_tpu
@@ -255,11 +408,14 @@ def bench_overload() -> dict:
 
 def main():
     # invariant: the SLO record exists before anything can hang
-    diurnal = _smoke_first()
+    diurnal, rolling = _smoke_first()
 
     import ray_tpu
-    ray_tpu.init(resources={"CPU": 12, "memory": 8}, num_workers=6)
+    # 16-replica hot-swap needs room for the replica actors plus the
+    # controller/ingress helpers
+    ray_tpu.init(resources={"CPU": 24, "memory": 16}, num_workers=20)
     try:
+        roll = bench_rolling()
         unbatched, batched = bench_batching()
         http = bench_overload()
     finally:
@@ -269,15 +425,22 @@ def main():
 
     speedup = batched / unbatched
     live = {
+        "rolling": roll,
         "unbatched_rps": round(unbatched, 1),
         "batched_rps": round(batched, 1),
         "batching_speedup": round(speedup, 2),
         "overload": {k: round(v, 3) if isinstance(v, float) else v
                      for k, v in http.items()},
     }
-    _write_record(diurnal, live)
+    _write_record(diurnal, rolling, live)
+    hs, cs = roll["hot_swap"], roll["cold_restart"]
     print(json.dumps({
-        "metric": f"serve: unbatched {unbatched:.0f} | batched "
+        "metric": f"serve: {roll['replicas']}-replica hot-swap "
+                  f"{hs['dropped']} drops (flip downtime "
+                  f"{hs.get('max_flip_downtime_s', -1):.3f} s) vs "
+                  f"cold restart {cs['dropped']} drops"
+                  + ("" if roll["slo_pass"] else " [ROLLING SLO FAIL]")
+                  + f"; unbatched {unbatched:.0f} | batched "
                   f"{batched:.0f} req/s"
                   + ("" if speedup >= 2 else " [SPEEDUP < 2x]")
                   + f"; 2x-overload ingress {http['qps']:.0f} QPS, "

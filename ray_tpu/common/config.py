@@ -395,6 +395,39 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Reclaim drain deadline: a loaner replica still busy past this "
         "is force-killed so the node returns to the batch pool (the "
         "DRAINING machine's preemption-notice semantics)."),
+    # -- model-version plane (ray_tpu/versioning/) --------------------------
+    "rollout_flip_drain_timeout_s": (
+        float, 30.0,
+        "Per-replica drain deadline during a rolling update: once a "
+        "replica is pulled out of routing (begin_flip) its in-flight "
+        "requests — at most max_ongoing_requests deep — must reach "
+        "zero within this budget before the weight reload proceeds "
+        "anyway."),
+    "rollout_probe_timeout_s": (
+        float, 10.0,
+        "Timeout on the post-reload verification probe (the replica's "
+        "__check_health__ plus any operator-supplied probe); a probe "
+        "that hangs past this counts as failed and trips rollback."),
+    "rollout_slo_factor": (
+        float, 2.0,
+        "SLO-regression trip: if a deployment's latency EWMA (live) or "
+        "delta-p99 (sim) exceeds this multiple of the pre-rollout "
+        "baseline while flipping, the rollout rolls back."),
+    "rollout_session_idle_s": (
+        float, 30.0,
+        "Session-version pin expiry: a sticky session idle this long "
+        "is considered ended, so its version pin is dropped and new "
+        "requests from the session may land on the new version."),
+    "rollout_wave_fanout": (
+        int, 3,
+        "Fanout of the broadcast-tree wave that streams a staged "
+        "weight version 1->N to the replica hosts ahead of the flip "
+        "sequence."),
+    "version_retain_count": (
+        int, 2,
+        "How many sealed weight versions stay retained (pinned in the "
+        "object store / registry) for rollback; the seal step trims "
+        "older artifacts past this window."),
     # -- concurrency invariants (rtlint) ------------------------------------
     "rtlint_runtime_lock_order": (
         bool, False,

@@ -111,6 +111,12 @@ class Dashboard(BackgroundHTTPServer):
             if loans is not None:
                 out["loans"] = loans.stats()
             return out
+        if name == "versions":
+            try:
+                from ..versioning import VersionRegistry
+                return VersionRegistry().all()
+            except Exception:   # noqa: BLE001 — versioning absent/unused
+                return {}
         if name == "broadcasts":
             cluster = self._cluster
             out = {}
@@ -233,6 +239,7 @@ class Dashboard(BackgroundHTTPServer):
             '<a href="/api/objects">objects</a> · '
             '<a href="/api/placement_groups">placement groups</a> · '
             '<a href="/api/serve">serve</a> · '
+            '<a href="/api/versions">versions</a> · '
             '<a href="/api/leases">leases</a> · '
             '<a href="/api/broadcasts">broadcasts</a> · '
             '<a href="/api/health">health</a> · '

@@ -163,6 +163,7 @@ class HeadNode:
             "job_stop": self.jobs.stop,
             "stop_daemon": self._stop_async,
             "chaos": self._chaos,
+            "rollout": self._rollout,
         }
 
     def _job_submit(self, *args, **kwargs) -> str:
@@ -174,6 +175,32 @@ class HeadNode:
         if self._persist_path:
             self._snapshot()
         return job_id
+
+    def _rollout(self, op: str, deployment: str = "",
+                 **kwargs) -> dict:
+        """Model-version plane control/observe channel.  The rollout
+        controller itself runs driver-side (it owns the serve app);
+        the head exposes the KV-journaled registry — ``status`` — and
+        the operator control flags — ``pause``/``resume``/``abort`` —
+        that the driver-side controller polls between flips.  Because
+        the journal lives in the GCS-snapshotted KV, a promoted
+        standby serves the same view."""
+        from ..versioning import VersionRegistry
+        reg = VersionRegistry()
+        if op == "status":
+            if deployment:
+                rec = reg.record(deployment)
+                return {deployment: rec} if rec is not None else {}
+            return reg.all()
+        if op in ("pause", "abort"):
+            reg.set_control(deployment, op)
+            return {"deployment": deployment, "control": op}
+        if op == "resume":
+            reg.set_control(deployment, "")
+            return {"deployment": deployment, "control": ""}
+        raise ValueError(
+            f"unknown rollout op {op!r} "
+            f"(one of: status, pause, resume, abort)")
 
     def _chaos(self, op: str, **kwargs) -> dict:
         """Runtime control of the seeded network-chaos plane (shared
@@ -377,6 +404,7 @@ class HeadNode:
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
             "serve": self._serve_stats(),
+            "versions": self._version_stats(),
             "health": self._health_stats(cluster),
             "chaos": self._chaos_stats(),
         }
@@ -402,6 +430,29 @@ class HeadNode:
     def _chaos_stats() -> dict:
         from ..rpc import chaos
         return chaos.status() if chaos.is_enabled() else {"enabled": False}
+
+    @staticmethod
+    def _version_stats() -> dict:
+        # per-deployment model-version journal (current version plus
+        # any in-flight rollout's phase/progress); empty when the
+        # version registry has never been written
+        try:
+            from ..versioning import VersionRegistry
+            out = {}
+            for name, rec in VersionRegistry().all().items():
+                row = {"current": rec["current"],
+                       "previous": rec["previous"]}
+                ro = rec.get("rollout")
+                if ro is not None:
+                    row["rollout"] = {
+                        "to": ro["to"], "phase": ro["phase"],
+                        "flipped": ro["flipped"],
+                        "replicas": ro["replicas"],
+                        "error": ro["error"]}
+                out[name] = row
+            return out
+        except Exception:   # noqa: BLE001 — versioning absent/unused
+            return {}
 
     @staticmethod
     def _serve_stats() -> dict:

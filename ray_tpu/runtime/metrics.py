@@ -221,6 +221,32 @@ def render_metrics(cluster) -> str:
         _fmt("serve_gossip_digest_size", s.get("gossip_digest", 0),
              "Replica load entries on the gossip board", lbl, out)
 
+    # model-version plane (per-deployment: current version plus any
+    # in-flight rollout's phase and flip progress)
+    try:
+        from ..versioning import VersionRegistry
+        versions = VersionRegistry().all()
+    except Exception:   # noqa: BLE001 — versioning absent/unused
+        versions = {}
+    _PHASE_IDS = {"STAGING": 1, "BROADCASTING": 2, "FLIPPING": 3,
+                  "PAUSED": 4, "SEALED": 5, "ROLLED_BACK": 6}
+    for dep, rec in sorted(versions.items()):
+        lbl = {"deployment": dep}
+        _fmt("serve_model_version",
+             int(str(rec.get("current", "v1")).lstrip("v") or 1),
+             "Current model version number", lbl, out)
+        ro = rec.get("rollout")
+        if ro is None:
+            continue
+        _fmt("serve_rollout_phase", _PHASE_IDS.get(ro["phase"], 0),
+             "Rollout phase (1=STAGING 2=BROADCASTING 3=FLIPPING "
+             "4=PAUSED 5=SEALED 6=ROLLED_BACK)", lbl, out)
+        _fmt("serve_rollout_flipped_replicas", ro.get("flipped", 0),
+             "Replicas flipped to the rollout's target version", lbl,
+             out)
+        _fmt("serve_rollout_total_replicas", ro.get("replicas", 0),
+             "Replicas the rollout set out to flip", lbl, out)
+
     # gossiped load board (process-local, shared by every deployment)
     try:
         from ..serve.gossip import board

@@ -313,6 +313,20 @@ def cmd_status(args) -> int:
                 line += (f" batches={s['batches']}"
                          f"(mean={s['batch_size_mean']})")
             print(line)
+    versions = st.get("versions") or {}
+    if versions:
+        print(f"model versions ({len(versions)}):")
+        for name in sorted(versions):
+            v = versions[name]
+            line = (f"  {name}  current={v.get('current')} "
+                    f"previous={v.get('previous') or '—'}")
+            ro = v.get("rollout")
+            if ro:
+                line += (f"  rollout->{ro['to']} {ro['phase']} "
+                         f"{ro['flipped']}/{ro['replicas']}")
+                if ro.get("error"):
+                    line += f" ({ro['error']})"
+            print(line)
     return 0
 
 
@@ -328,6 +342,42 @@ def cmd_drain(args) -> int:
     print(f"{st['node_id'][:16]}…  {st['state']} "
           f"deadline_s={st['deadline_s']} reason={st['reason']}")
     return 0
+
+
+def cmd_rollout(args) -> int:
+    """``ray_tpu rollout <deployment> [artifact]`` — model-version
+    plane.  Without an artifact: print the deployment's KV-journaled
+    version record (or every deployment's, with no name).
+    ``--pause/--resume/--abort`` write the operator control flag the
+    driver-side controller polls between flips — routed through the
+    head so the flag lands in the GCS-snapshotted KV and survives a
+    standby promotion.  With an artifact path: run the rolling update
+    from THIS process; the serve control plane is driver-hosted, so
+    starting a rollout only works where the app was deployed (scripts
+    embedding ``cli.main`` or an interactive driver) — elsewhere use
+    ``ray_tpu.versioning.rollout`` on the driver."""
+    op = ("pause" if args.pause else "resume" if args.resume
+          else "abort" if args.abort else None)
+    if op is not None or args.artifact is None:
+        client = _client(args.address)
+        try:
+            out = client.call("rollout", op or "status",
+                              deployment=args.deployment or "",
+                              timeout=30.0)
+        finally:
+            client.close()
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    if not args.deployment:
+        raise SystemExit("rollout start needs a deployment (app) name")
+    from .. import versioning
+    with open(args.artifact, "rb") as f:
+        artifact = f.read()
+    summary = versioning.rollout(
+        artifact, app_name=args.deployment,
+        artifact_label=os.path.basename(args.artifact))
+    print(json.dumps(summary, indent=2, default=str))
+    return 0 if summary.get("phase") == "SEALED" else 1
 
 
 def cmd_chaos(args) -> int:
@@ -771,6 +821,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: drain_deadline_s config)")
     pd.add_argument("--address", default=None)
     pd.set_defaults(fn=cmd_drain)
+
+    pr = sub.add_parser(
+        "rollout", help="model-version plane: show the version "
+        "journal, pause/resume/abort an in-flight rolling update, or "
+        "run one (driver-hosted: start only works where the app runs)")
+    pr.add_argument("deployment", nargs="?", default="",
+                    help="serve app name (omit to list every "
+                         "deployment's version record)")
+    pr.add_argument("artifact", nargs="?", default=None,
+                    help="path to the new weight artifact — starts a "
+                         "rolling update and blocks until SEALED or "
+                         "ROLLED_BACK")
+    pr.add_argument("--pause", action="store_true",
+                    help="hold the flip loop after the current replica")
+    pr.add_argument("--resume", action="store_true",
+                    help="release a paused rollout")
+    pr.add_argument("--abort", action="store_true",
+                    help="stop flipping and roll back to the old "
+                         "version")
+    pr.add_argument("--address", default=None)
+    pr.set_defaults(fn=cmd_rollout)
 
     pc = sub.add_parser(
         "chaos", help="control the seeded network-chaos plane "

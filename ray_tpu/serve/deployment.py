@@ -144,10 +144,36 @@ class _ReplicaShell:
             else kv_key
         self._active = 0
         self._active_lock = threading.Lock()
+        self._model_version = "v1"
 
     def _active_count(self) -> int:
         with self._active_lock:
             return self._active
+
+    def _reload(self, artifact, version: str) -> dict:
+        """Hot-swap step on a DRAINED replica (the rollout controller
+        pulls it out of routing first): hand the new weights to the
+        user object's ``reload(artifact)`` if it defines one, re-tag
+        the model version, and run the verification probe
+        (``__check_health__`` when defined).  ``artifact`` arrives as
+        the broadcast-staged value (ObjectRef args resolve before the
+        call, so the bytes come off the replica-local copy the tree
+        delivered); ``None`` re-tags only (rollback with no retained
+        artifact)."""
+        ok = True
+        if artifact is not None and hasattr(self._obj, "reload"):
+            try:
+                self._obj.reload(artifact)
+            except Exception:   # noqa: BLE001 — a throwing reload is a
+                ok = False      # failed probe, not a dead replica
+        self._model_version = version
+        if ok and hasattr(self._obj, "__check_health__"):
+            try:
+                ok = bool(self._obj.__check_health__())
+            except Exception:   # noqa: BLE001 — same contract
+                ok = False
+        return {"ok": ok, "version": version,
+                "active": self._active_count()}
 
     def __serve_call__(self, method: str, args: tuple, kwargs: dict,
                        model_id: str = ""):
@@ -231,7 +257,11 @@ class _Controller:
         self._replicas: list = []
         self._loaners: list = []    # replicas on LOANED batch nodes
         self._retiring: list = []   # loaners draining for reclaim
+        self._flipping: list = []   # replicas out of routing mid-flip
         self._version = 0
+        self._model_version = "v1"  # the deployment's SERVING version
+        self._replica_versions: dict[str, str] = {}  # actor hex -> ver
+        self._rollout_active = False
         self._last_scale = time.monotonic()
         if autoscaling:
             n = autoscaling.get("min_replicas", 1)
@@ -252,11 +282,15 @@ class _Controller:
         handle = stub.remote(self._target_bytes, self._init_args_bytes,
                              self._kv_key)
         self._replicas.append(handle)
+        self._replica_versions[handle._actor_id.binary().hex()] = \
+            self._model_version
         self._version += 1
 
     def _stop_replica(self) -> None:
         import ray_tpu
         handle = self._replicas.pop()
+        self._replica_versions.pop(handle._actor_id.binary().hex(),
+                                   None)
         self._version += 1
         ray_tpu.kill(handle)
 
@@ -275,6 +309,12 @@ class _Controller:
                     # regular pool cannot grow past its configured cap
                     "at_max": len(self._replicas) >= hi,
                     "loaners": len(self._loaners),
+                    # model-version plane: per-replica version tags so
+                    # routers can pin sessions to a consistent version
+                    # while a rollout is mid-flight
+                    "model_version": self._model_version,
+                    "replica_versions": dict(self._replica_versions),
+                    "rollout_active": self._rollout_active,
                 })
 
     # -- elastic capacity loaning (driver LoanManager calls these) -----------
@@ -292,6 +332,8 @@ class _Controller:
         handle = actor_cls.options(**opts).remote(
             self._target_bytes, self._init_args_bytes, self._kv_key)
         self._loaners.append(handle)
+        self._replica_versions[handle._actor_id.binary().hex()] = \
+            self._model_version
         self._version += 1
         return handle
 
@@ -328,6 +370,76 @@ class _Controller:
                     pass
                 return True
         return False
+
+    # -- model-version plane (versioning/rollout.py calls these) -------------
+    def begin_flip(self, key_hex: str) -> bool:
+        """Flip step 1: pull the replica out of the routing set
+        (version bump -> shards stop dispatching to it) but keep it
+        alive to drain its in-flight requests — the retire-loaner
+        two-step, applied to a regular replica for a weight swap."""
+        for h in self._replicas:
+            if h._actor_id.binary().hex() == key_hex:
+                self._replicas.remove(h)
+                self._flipping.append(h)
+                self._version += 1
+                return True
+        return False
+
+    def commit_flip(self, key_hex: str, model_version: str) -> bool:
+        """Flip step 2 (success): the drained replica reloaded and
+        probed healthy — re-enter routing under the new version tag."""
+        for h in list(self._flipping):
+            if h._actor_id.binary().hex() == key_hex:
+                self._flipping.remove(h)
+                self._replicas.append(h)
+                self._replica_versions[key_hex] = model_version
+                self._version += 1
+                return True
+        return False
+
+    def cancel_flip(self, key_hex: str, dead: bool = False) -> bool:
+        """Flip step 2 (failure): probe failed (back into routing on
+        the OLD version, untouched) or the replica died mid-flip
+        (dropped from the set entirely)."""
+        import ray_tpu
+        for h in list(self._flipping):
+            if h._actor_id.binary().hex() == key_hex:
+                self._flipping.remove(h)
+                if dead:
+                    self._replica_versions.pop(key_hex, None)
+                    try:
+                        ray_tpu.kill(h)
+                    except Exception:   # noqa: BLE001 — already dead
+                        pass
+                else:
+                    self._replicas.append(h)
+                self._version += 1
+                return True
+        return False
+
+    def flipping_handles(self) -> list:
+        return list(self._flipping)
+
+    def set_model_version(self, model_version: str) -> None:
+        """Seal: new replicas (scale-up, loaners) now start on this
+        version."""
+        self._model_version = model_version
+
+    def model_version(self) -> str:
+        return self._model_version
+
+    def set_rollout_active(self, active: bool) -> None:
+        """Routers pin sessions to per-replica version tags only while
+        a rollout is actually mid-flight (the pin table costs a dict
+        lookup per pick)."""
+        self._rollout_active = bool(active)
+        self._version += 1
+
+    def version_counts(self) -> dict:
+        out: dict[str, int] = {}
+        for v in self._replica_versions.values():
+            out[v] = out.get(v, 0) + 1
+        return out
 
     def ensure_replica(self):
         """Cold start for scale-to-zero: a request arrived while no
@@ -406,16 +518,21 @@ class _Controller:
                 "replicas": len(self._replicas),
                 "loaners": len(self._loaners),
                 "inflight": inflight, "queued": queued,
-                "latency_ewma_ms": lat_ms}
+                "latency_ewma_ms": lat_ms,
+                "model_version": self._model_version,
+                "version_counts": self.version_counts(),
+                "rollout_active": self._rollout_active}
 
     def shutdown(self) -> None:
         import ray_tpu
         for h in list(self._replicas) + list(self._loaners) + \
-                list(self._retiring):
+                list(self._retiring) + list(self._flipping):
             ray_tpu.kill(h)
         self._replicas.clear()
         self._loaners.clear()
         self._retiring.clear()
+        self._flipping.clear()
+        self._replica_versions.clear()
         # the deployment's KV counters (inflight/queued/lat/batch*) are
         # keyed by a per-controller random base: delete them, or every
         # run/delete cycle leaks namespace entries forever

@@ -47,12 +47,14 @@ class _Folded:
     shard's contribution at fold time (so a shard can subtract itself
     back out and never double-count its own live dispatches)."""
 
-    __slots__ = ("t", "total", "per_shard")
+    __slots__ = ("t", "total", "per_shard", "versions")
 
-    def __init__(self, t: float, total: dict, per_shard: dict):
+    def __init__(self, t: float, total: dict, per_shard: dict,
+                 versions: dict | None = None):
         self.t = t
         self.total = total          # replica_key -> summed inflight
         self.per_shard = per_shard  # shard_id -> {replica_key: inflight}
+        self.versions = versions or {}  # replica_key -> model version
 
 
 class LoadBoard:
@@ -68,11 +70,15 @@ class LoadBoard:
 
     # -- publish -------------------------------------------------------------
     def fold(self, base: str, shard_digests: dict[int, dict[bytes, int]],
-             live: set[bytes]) -> None:
+             live: set[bytes],
+             versions: dict[bytes, str] | None = None) -> None:
         """Merge the shards' digest maps for one deployment.  Entries
         for replicas outside ``live`` (the controller's current
         membership) are evicted — dead, downscaled, and reclaimed
-        replicas must not haunt the load view (or grow it forever)."""
+        replicas must not haunt the load view (or grow it forever).
+        ``versions`` tags each live replica with its model version so
+        digest readers (metrics, status) can see rollout progress
+        without an extra controller RPC."""
         total: dict[bytes, int] = {}
         per_shard: dict[int, dict[bytes, int]] = {}
         dropped = 0
@@ -85,9 +91,10 @@ class LoadBoard:
                 kept[key] = n
                 total[key] = total.get(key, 0) + n
             per_shard[sid] = kept
+        ver = {k: v for k, v in (versions or {}).items() if k in live}
         with self._lock:
             self._folded[base] = _Folded(_clk.monotonic(), total,
-                                         per_shard)
+                                         per_shard, ver)
             self.folds += 1
             self.evicted_replicas += dropped
 
@@ -118,6 +125,18 @@ class LoadBoard:
         with self._lock:
             f = self._folded.get(base)
             return len(f.total) if f is not None else 0
+
+    def version_counts(self, base: str) -> dict[str, int]:
+        """Replicas per model version in the folded digest — the
+        gossip-eye view of rollout progress."""
+        with self._lock:
+            f = self._folded.get(base)
+            if f is None:
+                return {}
+            out: dict[str, int] = {}
+            for v in f.versions.values():
+                out[v] = out.get(v, 0) + 1
+            return out
 
     def stats(self) -> dict:
         with self._lock:

@@ -234,7 +234,12 @@ class RequestRouter:
                     self._version, self._replicas = version, replicas
                     self._kv_inflight = kv_key.encode()
                     self._kv_base = cfg.get("base", "")
+                    was_rolling = self._cfg.get("rollout_active", False)
                     self._cfg = cfg
+                    if was_rolling and not cfg.get("rollout_active") \
+                            and self._group is not None:
+                        # rollout sealed/rolled back: one version again
+                        self._group.clear_version_pins()
                 self._cv.notify_all()
 
     def _ensure_view(self) -> None:
@@ -289,7 +294,11 @@ class RequestRouter:
         multiplexed model id overrides with rendezvous hashing so one
         model's calls stick to one replica (its ``@multiplexed`` LRU
         stays hot) — a saturated sticky replica returns None (the
-        request queues rather than breaking stickiness)."""
+        request queues rather than breaking stickiness).  While a
+        rolling update is in flight the candidate set first narrows to
+        the session's pinned model version (never to empty — the pin
+        migrates when its version has no replica left), so no sticky
+        session straddles two weight versions mid-flip."""
         import random
         reps = self._replicas
         if not reps:
@@ -303,6 +312,9 @@ class RequestRouter:
                        if r._actor_id.binary() not in suspects]
             if healthy:
                 reps = healthy
+        if mux and self._group is not None and \
+                self._cfg.get("rollout_active"):
+            reps = self._group.pin_candidates(mux, reps, self._cfg)
         cap = self._cfg.get("max_ongoing", 4)
         if mux and len(reps) > 1:
             import hashlib
@@ -646,6 +658,12 @@ class RouterGroup:
         self._rr = itertools.count()
         self._fold_lock = threading.Lock()
         self._folded_at = 0.0
+        # session/mux -> pinned model version, only populated while the
+        # controller reports a rollout in flight.  Group-level (not
+        # per-shard) so restart_shard cannot drop a live session's pin.
+        self._version_pins: dict[str, str] = {}
+        self._pin_lock = threading.Lock()
+        self.pin_migrations = 0
 
     # -- shard choice --------------------------------------------------------
     def shard_for(self, session: str | None) -> RequestRouter:
@@ -669,6 +687,41 @@ class RouterGroup:
         return self.shard_for(session or mux).submit(
             method, args, kwargs, mux, stream, timeout_s)
 
+    # -- model-version pinning (rolling updates) -----------------------------
+    def pin_candidates(self, key: str, reps: list, cfg: dict) -> list:
+        """Narrow ``reps`` to the session's pinned model version while
+        a rollout is in flight.  First sight pins to the version
+        serving right now; a pin whose version has no replica left
+        migrates to the current serving version rather than starving
+        the session.  Never returns empty given non-empty ``reps``."""
+        rv = cfg.get("replica_versions", {})
+        serving = cfg.get("model_version", "v1")
+        with self._pin_lock:
+            pin = self._version_pins.setdefault(key, serving)
+        subset = [r for r in reps
+                  if rv.get(r._actor_id.binary().hex(), serving) == pin]
+        if subset:
+            return subset
+        if pin != serving:
+            with self._pin_lock:
+                self._version_pins[key] = serving
+                self.pin_migrations += 1
+            subset = [r for r in reps
+                      if rv.get(r._actor_id.binary().hex(),
+                                serving) == serving]
+        return subset or reps
+
+    def clear_version_pins(self) -> None:
+        """Called when a refresh observes the rollout over (sealed or
+        rolled back): every replica is back on one version, so pins
+        would only misfilter the NEXT rollout."""
+        with self._pin_lock:
+            self._version_pins.clear()
+
+    def version_pins(self) -> dict[str, str]:
+        with self._pin_lock:
+            return dict(self._version_pins)
+
     # -- gossip --------------------------------------------------------------
     def fold(self) -> None:
         """Snapshot every shard's digest (each under its own lock, none
@@ -678,13 +731,20 @@ class RouterGroup:
         digests: dict[int, dict[bytes, int]] = {}
         live: set[bytes] = set()
         base = ""
+        versions: dict[bytes, str] = {}
         for s in self._shards:
             with s._cv:
                 digests[s._shard_id] = dict(s._inflight)
                 live.update(r._actor_id.binary() for r in s._replicas)
                 base = base or s._kv_base
+                rv = s._cfg.get("replica_versions")
+                if rv:
+                    serving = s._cfg.get("model_version", "v1")
+                    for r in s._replicas:
+                        key = r._actor_id.binary()
+                        versions[key] = rv.get(key.hex(), serving)
         if base:
-            board.fold(base, digests, live)
+            board.fold(base, digests, live, versions=versions)
             self._folded_at = _now()
 
     def maybe_fold(self) -> None:

@@ -33,7 +33,8 @@ __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
 
 CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
              "drain_churn", "autoscaler_flap", "broadcast_storm",
-             "serve_diurnal", "head_failover_storm")
+             "serve_diurnal", "head_failover_storm",
+             "serve_rolling_update")
 
 # the failover storm snaps task durations to a small class set so the
 # job stream is a repeat-class workload — the shape the lease plane's
@@ -143,11 +144,18 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
                                 ("partition", 0.3),
                                 ("kill_node", 0.25),
                                 ("gray_slow", 0.1)),
+        # rolling weight hot-swaps landing mid-peak while kills hit
+        # replicas (and the head, mid-broadcast): the model-version
+        # plane's flip/rollback machinery and session pinning under fire
+        "serve_rolling_update": (("rollout", 0.25), ("kill_node", 0.35),
+                                 ("gray_slow", 0.15), ("drain", 0.15),
+                                 ("kill_head", 0.1)),
     }
     ops, weights = zip(*mixes[campaign])
     sched = []
     window = (duration * 0.05, duration * 0.85)
     head_kills = 0
+    rollouts = 0
     for _ in range(faults):
         t = float(rng.uniform(*window))
         u = float(rng.random())
@@ -188,6 +196,19 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
             addr = _node_addr(target)
             sched.append((t, "gray_slow", {"addr": addr}))
             sched.append((t + heal_after, "gray_heal", {"addr": addr}))
+            continue
+        if op == "rollout":
+            # land mid-peak (the acceptance window the bench measures);
+            # a quarter of rollouts carry an injected probe failure so
+            # the rollback path is exercised, not just the happy seal
+            t_roll = float(rng.uniform(duration * 0.30,
+                                       duration * 0.65))
+            pf = float(rng.random())
+            rollouts += 1
+            sched.append((t_roll, "rollout", {
+                "artifact": f"weights-{rollouts:03d}",
+                "probe_fail_at": target % 8 if pf < 0.25 else -1,
+            }))
             continue
         if op == "broadcast":
             count = int(rng.integers(max(2, num_nodes // 3),
@@ -247,11 +268,15 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
     if coverage is not None:
         cluster.trace.cov = coverage
     plane = None
-    if campaign == "serve_diurnal":
+    rplane = None
+    if campaign in ("serve_diurnal", "serve_rolling_update"):
         from .serve import SimServePlane
         plane = SimServePlane(cluster, seed=seed, duration=duration,
                               **(serve or {}))
         cluster.serve_plane = plane
+    if campaign == "serve_rolling_update":
+        from .rollout import SimRolloutPlane
+        rplane = SimRolloutPlane(cluster, plane)
     if lock_order:
         from ..common import lockorder
         if not lockorder.installed():
@@ -306,6 +331,15 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                 if plane is not None:
                     plane.on_node_killed(kw["node"])
             trace.rec(t, "fault", op=op, node=kw["node"], hit=hit)
+        elif op == "rollout":
+            rid = ""
+            if rplane is not None:
+                rid = rplane.start_rollout(
+                    kw["artifact"],
+                    probe_fail_at=kw.get("probe_fail_at", -1))
+            trace.rec(t, "fault", op=op, artifact=kw["artifact"],
+                      probe_fail_at=kw.get("probe_fail_at", -1),
+                      rollout=rid)
         elif op == "broadcast":
             from .broadcast import SimBroadcastWave
             w = SimBroadcastWave(cluster, f"w{len(waves)}",
@@ -379,7 +413,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                 completed_cache["n"] = done
                 return done == len(acked) and \
                     all(w.terminal for w in waves) and \
-                    (plane is None or plane.terminal)
+                    (plane is None or plane.terminal) and \
+                    (rplane is None or rplane.all_terminal)
 
             settle_end = duration + _SETTLE_CAP_S
             while not all_done() and clock.monotonic() < settle_end:
@@ -408,6 +443,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         stats=cluster.stats())
     if plane is not None:
         result.stats["serve"] = plane.stats()
+    if rplane is not None:
+        result.stats["rollout"] = rplane.stats()
     if out:
         write_artifact(out, result, trace, duration, faults,
                        schedule=schedule, params=cluster.params)
@@ -418,6 +455,7 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
 # resolved values reproduction depends on, so a replay is a pure
 # function of the artifact, never of the ambient env
 _KNOB_PREFIXES = ("chaos_", "lease_", "serve_", "sim_", "standby_",
+                  "rollout_", "version_",
                   "rpc_breaker_", "rtlint_runtime_lock_order")
 
 

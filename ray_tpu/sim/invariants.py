@@ -65,6 +65,19 @@ The invariants, and the machinery each one proves:
   local cache actually admits).  Nodes mid-revocation (cache epoch
   behind the grantor's) and classes the grantor LRU-evicted (eviction
   does not bump the epoch) are out of scope.
+- **version-mixed-session** / **rollout-terminal** /
+  **old-version-retained** (r18) — the model-version plane (when a
+  ``serve_rolling_update`` campaign installed one): no accepted
+  request is served off its session's pinned version (session-sticky
+  routing keeps every live session on ONE version across the flip
+  sequence; the pin migrates forward — at a request boundary — only
+  when its version has no live replica left or is queuing
+  wall-to-wall while the frontier has headroom, so per-request
+  consistency holds either way); strictly, every rollout reaches
+  SEALED or ROLLED_BACK by
+  quiesce; and an in-flight rollout never drops the old version's
+  retained artifact before seal (rollback must always have weights to
+  re-flip onto).
 """
 
 from __future__ import annotations
@@ -97,6 +110,10 @@ INVARIANTS = {
     "bcast-live-replica": "strict final: live wave members hold replicas",
     "budget-conservation":
         "locally-admitted grants never exceed head-emitted budgets",
+    "version-mixed-session":
+        "no request served off its session's pinned model version",
+    "rollout-terminal": "strict final: every rollout SEALED/ROLLED_BACK",
+    "old-version-retained": "old weights retained until the seal",
 }
 
 _NAME_RE = re.compile(r"\[inv:([a-z0-9-]+) @t=")
@@ -370,6 +387,14 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
         sv, sn = plane.check(strict=strict, now=now, grace=grace)
         violations.extend(sv)
         checks += sn
+
+    # model-version plane (when a serve_rolling_update campaign
+    # installed one)
+    rplane = getattr(cluster, "rollout_plane", None)
+    if rplane is not None:
+        rv, rn = rplane.check(strict=strict, now=now, grace=grace)
+        violations.extend(rv)
+        checks += rn
 
     # lease-double-exec; head-independent: the logs live on the
     # cluster, so this audits through head-down windows and across

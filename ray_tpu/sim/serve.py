@@ -74,7 +74,7 @@ class SimServeParams:
 
 class _Replica:
     __slots__ = ("nid", "cap", "inflight", "queue", "loaned", "alive",
-                 "route_ok", "epoch")
+                 "route_ok", "epoch", "version")
 
     def __init__(self, nid: str, cap: int, loaned: bool = False):
         self.nid = nid
@@ -85,6 +85,7 @@ class _Replica:
         self.alive = True
         self.route_ok = True
         self.epoch = 0          # bumped on death: stale completions no-op
+        self.version = "v1"     # model version tag (rollout plane re-tags)
 
     def load(self) -> int:
         return len(self.inflight) + len(self.queue)
@@ -156,6 +157,10 @@ class SimServePlane:
         self._reclaim_max = 0.0
         self._win = {"accepted": 0, "completed": 0, "shed": 0}
         self._hist = [0] * (len(_LAT_EDGES) + 1)
+        # model-version plane (sim/rollout.py) — None on every campaign
+        # except serve_rolling_update, so no hook below changes the
+        # behavior (or replay hash) of existing serve runs
+        self.rollout = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -203,6 +208,8 @@ class SimServePlane:
             self.outstanding += 1
             self._win["accepted"] += 1
             shard.queue.append((self._rid, now))
+            if self.rollout is not None:
+                self.rollout.note_arrival(self._rid, session, now)
             self._pump(shard)
         self.cluster.clock.call_later(self.p.arrival_tick_s,
                                       self._arrivals)
@@ -234,6 +241,10 @@ class SimServePlane:
             shard.queue.appendleft((rid, t_arr))
             self.cluster.clock.call_later(1.0, lambda: self._pump(shard))
             return
+        if self.rollout is not None:
+            # session-version pinning: candidates narrow to the pinned
+            # version (never to empty — the pin migrates instead)
+            live = self.rollout.filter_candidates(rid, live)
         if len(live) == 1:
             cands = [live[0]]
         else:
@@ -290,6 +301,8 @@ class SimServePlane:
         self.completed += 1
         self.outstanding -= 1
         self._win["completed"] += 1
+        if self.rollout is not None:
+            self.rollout.on_complete(rid, rep.version)
         if rep.queue:
             nrid, nt = rep.queue.popleft()
             self._begin(rep, nrid, nt)
@@ -467,6 +480,10 @@ class SimServePlane:
         self.replicas[nid] = _Replica(nid, self.p.replica_cap,
                                       loaned=True)
         self.digest[nid] = 0
+        if self.rollout is not None:
+            # graft-on-pull: a late-joining replica adopts the
+            # phase-appropriate model version
+            self.rollout.on_replica_added(nid)
         self.cluster.trace.rec(
             self.cluster.clock.monotonic(), "loan_active", node=nid,
             warmup_s=self.p.warmup_s,

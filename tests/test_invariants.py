@@ -8,10 +8,11 @@ registry and asserted complete — adding an invariant without its twin
 fails ``test_every_invariant_has_a_twin``.
 
 The healthy fixture is a real 4-node cluster (lease plane on) that ran
-a job to completion, with a quiet serve plane attached, legal
-revocation history, and terminal + active broadcast waves present — so
-the quiet half actually exercises every checker's pass path, not just
-its absence.
+a job to completion, with a quiet serve plane attached, a rollout
+plane carrying one sealed and one in-flight rollout, legal revocation
+history, and terminal + active broadcast waves present — so the quiet
+half actually exercises every checker's pass path, not just its
+absence.
 """
 
 from dataclasses import replace
@@ -67,6 +68,22 @@ def _healthy_cluster():
     plane.accepted = plane.completed = 2
     plane.loans_total = plane.reclaims_total = 1    # ...and balanced
     cluster.serve_plane = plane
+
+    # model-version plane: one sealed rollout plus one mid-flip (the
+    # strict pass seals it via _finish_waves, mirroring campaign
+    # quiesce); old versions retained on both
+    from ray_tpu.sim.rollout import SimRolloutPlane
+    rplane = SimRolloutPlane(cluster, plane)
+
+    def _ro(rid, frm, to, phase, t_done):
+        return {"id": rid, "from": frm, "to": to, "phase": phase,
+                "flipped": 1, "replicas": 2, "old_retained": True,
+                "probe_fail_at": -1, "t_start": 1.0, "t_done": t_done,
+                "error": "", "pre_p99_s": 0.1, "during_p99_s": 0.1}
+
+    rplane.rollouts = [_ro("r2", "v1", "v2", "SEALED", 4.0),
+                       _ro("r3", "v2", "v3", "FLIPPING", None)]
+    rplane.active = rplane.rollouts[1]
 
     # legal revocation history: strictly increasing epochs
     cluster.revocation_log["n00003"] = [(1, 5.0), (2, 6.0)]
@@ -206,10 +223,32 @@ def _bcast_live_replica(c, acked):
         "w-gap", t_done=6.0, terminal=True, unreached=("b",)))
 
 
+def _version_mixed_session(c, acked):
+    c.rollout_plane.mixed_served += 1
+
+
+def _rollout_terminal(c, acked):
+    # strict final with the in-flight rollout still not terminal
+    pass
+
+
+def _old_version_retained(c, acked):
+    # the active rollout dropped its old artifact before sealing
+    c.rollout_plane.active["old_retained"] = False
+
+
 def _finish_waves(c):
     for w in c.broadcast_waves:
         if w.t_done is None:
             w.t_done, w.terminal = _now(c), True
+    # quiesce twin for the rollout plane: active rollouts seal
+    rp = getattr(c, "rollout_plane", None)
+    if rp is not None:
+        for ro in rp.rollouts:
+            if ro["phase"] not in ("SEALED", "ROLLED_BACK"):
+                ro["phase"], ro["t_done"] = "SEALED", _now(c)
+        rp.active = None
+        rp.queued.clear()
 
 
 CORRUPTIONS = {
@@ -233,6 +272,9 @@ CORRUPTIONS = {
     "bcast-wave-terminal": (_bcast_wave_terminal, True),
     "bcast-live-replica": (_bcast_live_replica, True),
     "budget-conservation": (_budget_conservation, False),
+    "version-mixed-session": (_version_mixed_session, False),
+    "rollout-terminal": (_rollout_terminal, True),
+    "old-version-retained": (_old_version_retained, False),
 }
 
 
@@ -248,7 +290,8 @@ def test_invariant_fires_on_corrupted_state(name):
     cluster, acked = _healthy_cluster()
     try:
         corrupt(cluster, acked)
-        if strict and name not in ("bcast-wave-terminal",):
+        if strict and name not in ("bcast-wave-terminal",
+                                   "rollout-terminal"):
             _finish_waves(cluster)
         v, checks = check_invariants(cluster, acked, strict=strict)
         assert name in violation_names(v), (name, v)

@@ -884,3 +884,28 @@ class TestW6:
             files=[os.path.join(REPO_ROOT, m) for m in (board, contract)])
         assert [f for f in findings if f.rule != "E0"] == [], \
             "budget seam must stay clock- and sync-free"
+
+    def test_versioning_modules_in_scope_with_zero_baseline(self):
+        """The r18 model-version plane (``ray_tpu/versioning/``) is
+        inside W5's clock-seam scope (rollout timings must go through
+        the seam so the sim twin replays) AND W6's device-sync scope,
+        and contributes zero grandfathered baseline entries."""
+        from tools.rtlint import rules_device, rules_time
+        new_modules = ("ray_tpu/versioning/registry.py",
+                       "ray_tpu/versioning/rollout.py",
+                       "ray_tpu/versioning/phases.py")
+        for mod in new_modules:
+            assert os.path.exists(os.path.join(REPO_ROOT, mod))
+            assert any(mod.startswith(sc) for sc in rules_time._SCOPES)
+            assert any(mod.startswith(sc) for sc in rules_device._SCOPES)
+        accepted = baseline_mod.load(os.path.join(
+            REPO_ROOT, "tools", "rtlint", "baseline.json"))
+        for key in accepted:
+            assert "ray_tpu/versioning/" not in key, \
+                f"grandfathered finding in a new module: {key}"
+        # live, not vacuous: the package passes W5+W6 as it stands
+        findings = analyzer.run_analysis(
+            REPO_ROOT, package="ray_tpu", rules=("W5", "W6"),
+            files=[os.path.join(REPO_ROOT, m) for m in new_modules])
+        assert [f for f in findings if f.rule != "E0"] == [], \
+            "versioning plane must stay clock- and sync-free"

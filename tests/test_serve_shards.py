@@ -124,6 +124,54 @@ class TestShardStickiness:
         assert ray_tpu.get([h.remote(i) for i in range(4)],
                            timeout=60) == [0, 1, 2, 3]
 
+    def test_flip_stickiness_survives_shard_restart(self):
+        """A rolling update is mid-flip (one replica out of routing,
+        ``rollout_active`` on) when a shard crashes and is recreated.
+        The session's version pin is group-level and the mux->replica
+        rendezvous hashes over replica ids — so the session stays on a
+        consistent version and the warm mux replica never moves."""
+        @serve.deployment(num_replicas=3)
+        class Who:
+            def __call__(self, x):
+                return id(self)
+
+        handle = serve.run(Who.bind())
+        group = _group(num_shards=3)
+        ctl = serve.get_deployment_handle()._controller
+        reps = ray_tpu.get(ctl.get_replicas.remote(), timeout=60)[1]
+        key = reps[0]._actor_id.binary().hex()
+        ray_tpu.get(ctl.set_rollout_active.remote(True), timeout=30)
+        assert ray_tpu.get(ctl.begin_flip.remote(key), timeout=30)
+        group._refresh(force=True)
+        try:
+            h = handle.options(multiplexed_model_id="m-flip")
+            # a health-beat refresh racing set_rollout_active can
+            # install a stale (pre-rollout) config after our forced
+            # one, so the first requests may route unpinned — re-force
+            # until the pin engages rather than asserting one shot
+            deadline = time.monotonic() + 15
+            while True:
+                before = set(ray_tpu.get(
+                    [h.remote(i) for i in range(6)], timeout=60))
+                assert len(before) == 1, \
+                    "mux id routed to several replicas"
+                pin = group.version_pins().get("m-flip")
+                if pin is not None:
+                    break
+                assert time.monotonic() < deadline, "pin never engaged"
+                group._refresh(force=True)
+            sid = group.shard_for("m-flip")._shard_id
+            group.restart_shard(sid)
+            after = set(ray_tpu.get([h.remote(i) for i in range(6)],
+                                    timeout=60))
+            assert after == before, "re-shard moved the warm mux replica"
+            # the pin table lives on the GROUP: the restarted shard
+            # sees the same pin, not a fresh (possibly different) one
+            assert group.version_pins().get("m-flip") == pin
+        finally:
+            ray_tpu.get(ctl.commit_flip.remote(key, "v1"), timeout=30)
+            ray_tpu.get(ctl.set_rollout_active.remote(False), timeout=30)
+
 
 class TestGossipBoard:
     def test_fold_evicts_departed_replicas(self):

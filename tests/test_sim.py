@@ -120,7 +120,8 @@ def test_trace_artifact_format(tmp_path):
     assert doc["knobs"]
     for k, v in doc["knobs"].items():
         assert k.startswith(("chaos_", "lease_", "serve_", "sim_",
-                             "standby_", "rpc_breaker_",
+                             "standby_", "rollout_", "version_",
+                             "rpc_breaker_",
                              "rtlint_runtime_lock_order"))
         assert cfg[k] == v
     assert "sim_heartbeat_period_s" in doc["knobs"]

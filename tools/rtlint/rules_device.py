@@ -36,7 +36,8 @@ import re
 
 from .finding import Finding
 
-_SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/", "ray_tpu/leasing/")
+_SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/", "ray_tpu/leasing/",
+           "ray_tpu/versioning/")
 # single files pulled into scope without scoping their whole package:
 # the sim search loop (hunt/minimize) must never touch a device —
 # thousands of probe runs per hunt would serialize on any sync point

@@ -39,7 +39,8 @@ from .finding import Finding
 
 _CLOCK_FNS = ("time", "monotonic", "sleep")
 _SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/",
-           "ray_tpu/leasing/", "ray_tpu/serve/gossip.py",
+           "ray_tpu/leasing/", "ray_tpu/versioning/",
+           "ray_tpu/serve/gossip.py",
            "ray_tpu/serve/loaning.py",
            # the hunt must be a pure function of its Philox seed:
            # wall-clock reads would make search order (and therefore
