@@ -1,0 +1,317 @@
+"""Elastic training benchmark: a shared pool's diurnal day, survived.
+
+Two stages, mirroring bench.py's smoke-first discipline (a JSON record
+always lands, even if the live cluster hangs):
+
+- **diurnal** (smoke stage, disposable subprocess): the 128-node
+  simulated ``train_diurnal`` campaign — a gang-scheduled training run
+  sharing the pool with a diurnal serve deployment while rolling
+  SIGKILLs, drains, gray nodes and head kills land — against a no-fault
+  control run of the same day.  The SLO report checks the elasticity
+  bar (goodput >= 80% of the unfaulted control), that worker AND head
+  SIGKILLs actually fired mid-day and the run still finished, that
+  capacity loans flowed BOTH directions (serve borrowed idle batch rows
+  at its peak; train borrowed a quiet serve node at its trough), that
+  acked epochs never regressed, and that the whole day replays
+  bit-identically from (seed, params).  Written to ``TRAIN_r19.json``.
+- **live sigkill**: a real 2-worker ``ElasticTrainer`` gang on the
+  local pool, one member SIGKILLed mid-allreduce, vs an unkilled
+  control of the same run.  The kill must surface as a typed gang
+  membership event (zero ``max_failures`` burned), the gang re-forms
+  from the journaled epoch, and the run completes with monotone acked
+  epochs.  ``RT_BENCH_FORCE_SKIP=1`` (or any live-stage exception)
+  degrades to a skipped record with rc 0 — the smoke record survives.
+
+Prints one JSON line per stage and writes the full round record to
+``TRAIN_r19.json``.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+SIM_NODES = 128
+SIM_SEED = 19
+SIM_FAULTS = 40
+SIM_DURATION = 600.0
+GOODPUT_BAR = 0.8       # faulted goodput vs no-fault control
+
+LIVE_EPOCHS = 3
+LIVE_EPOCH_S = 0.8      # per-epoch compute: wide enough to hit
+
+RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "TRAIN_r19.json")
+
+
+# -- diurnal sim campaign (the smoke stage) -----------------------------------
+
+def diurnal_train_bench() -> dict:
+    """The simulated day: faulted run (twice, for the replay hash) plus
+    a no-fault control with an empty schedule — same seed, same arrival
+    curve, so goodput deltas are pure fault cost."""
+    from ray_tpu.sim import run_campaign
+
+    kw = dict(seed=SIM_SEED, campaign="train_diurnal",
+              faults=SIM_FAULTS, duration=SIM_DURATION)
+    trace = tempfile.mktemp(suffix=".json")
+    r1 = run_campaign(SIM_NODES, out=trace, **kw)
+    r2 = run_campaign(SIM_NODES, **kw)
+    ctl = run_campaign(SIM_NODES, seed=SIM_SEED,
+                       campaign="train_diurnal", faults=0,
+                       duration=SIM_DURATION, schedule=[])
+    assert r1.ok and ctl.ok, (r1.violations, ctl.violations)
+
+    ops: dict = {}
+    with open(trace, encoding="utf-8") as f:
+        for e in json.load(f)["events"]:
+            if e.get("kind") == "fault":
+                ops[e["op"]] = ops.get(e["op"], 0) + 1
+    os.unlink(trace)
+
+    t, c = r1.stats["train"], ctl.stats["train"]
+    sv = r1.stats["serve"]
+    ratio = t["goodput_sps"] / max(c["goodput_sps"], 1e-9)
+    slo = {
+        "goodput_ratio": round(ratio, 3),
+        "goodput_ok": ratio >= GOODPUT_BAR,
+        # the day actually bit: worker and head SIGKILLs landed and the
+        # run still reached its terminal state
+        "worker_sigkill_survived": (ops.get("kill_node", 0) > 0
+                                    and t["state"] == "done"),
+        "head_sigkill_survived": (ops.get("kill_head", 0) > 0
+                                  and t["state"] == "done"),
+        "gang_losses_recovered": (t["gang_losses"] > 0
+                                  and t["epochs_committed"] > 0),
+        # acked progress is monotone: every committed epoch was acked
+        "epochs_never_regress": t["acked_epoch"] == t["epochs_committed"],
+        # capacity flowed both ways across the one pool
+        "loans_both_directions": (sv["loans_total"] > 0
+                                  and t["borrows_total"] > 0),
+        "borrows_all_settled": (t["borrows_returned"]
+                                + t["borrows_lost"]
+                                == t["borrows_total"]),
+        "replay_bit_identical": r1.trace_hash == r2.trace_hash,
+    }
+    return {
+        "nodes": SIM_NODES, "seed": SIM_SEED, "faults": SIM_FAULTS,
+        "duration_s": SIM_DURATION, "fault_ops": ops,
+        "faulted": t, "control": c, "serve": {
+            k: sv[k] for k in ("loans_total", "reclaims_total",
+                               "loans_lost", "accepted", "completed")},
+        "trace_hash": r1.trace_hash,
+        "slo": slo, "slo_pass": all(slo.values()),
+    }
+
+
+def _emit_smoke() -> None:
+    """The --smoke entry: run the diurnal campaign trio in this
+    disposable subprocess and print exactly one JSON line."""
+    d = diurnal_train_bench()
+    bad = [k for k, v in d["slo"].items() if not v]
+    flags = "" if not bad else " [SLO FAIL: " + ", ".join(bad) + "]"
+    t = d["faulted"]
+    print(json.dumps({
+        "metric": f"train diurnal {SIM_NODES}-node sim: goodput "
+                  f"{d['slo']['goodput_ratio']}x no-fault control "
+                  f"through {d['fault_ops'].get('kill_node', 0)} node + "
+                  f"{d['fault_ops'].get('kill_head', 0)} head kills; "
+                  f"{t['epochs_committed']} epochs, "
+                  f"{t['gang_losses']} gang losses, "
+                  f"{t['borrows_total']} borrows / "
+                  f"{d['serve']['loans_total']} serve loans" + flags,
+        "value": d["slo"]["goodput_ratio"],
+        "unit": "x",
+        "vs_baseline": d["slo"]["goodput_ratio"],
+        "status": "smoke",
+        "diurnal": d,
+    }), flush=True)
+
+
+def _smoke_first() -> dict | None:
+    """Run the sim stage in a subprocess (a hung backend cannot eat the
+    record), print its JSON line, and seed TRAIN_r19.json so the
+    round's record exists before the live cluster starts."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    err = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env)
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if proc.returncode == 0 and lines:
+            print(lines[-1], flush=True)
+            record = json.loads(lines[-1])
+            _write_record(record.get("diurnal"), live=None)
+            return record.get("diurnal")
+        err = f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        err = "smoke subprocess exceeded 600s"
+    print(json.dumps({
+        "metric": f"train sim smoke FAILED [{err}]",
+        "value": -1.0, "unit": "x", "vs_baseline": 0.0,
+        "status": "smoke_failed"}), flush=True)
+    _write_record(None, live=None, error=err)
+    return None
+
+
+def _write_record(diurnal, live, error: str = "") -> None:
+    doc = {"format": "ray_tpu-train-bench/1", "round": 19,
+           "diurnal": diurnal, "live": live}
+    if error:
+        doc["error"] = error
+    with open(RECORD, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# -- live experiment ----------------------------------------------------------
+
+def _epoch_loop(last_epoch, sleep_s):
+    import numpy as np
+
+    from ray_tpu import train as rtrain
+    from ray_tpu.train import Checkpoint
+
+    def loop(config):
+        ctx = rtrain.get_context()
+        ck = rtrain.get_checkpoint()
+        start = ck.to_dict()["epoch"] + 1 if ck is not None else 0
+        for epoch in range(start, last_epoch + 1):
+            ctx.allreduce({"g": np.ones(64)})
+            time.sleep(sleep_s)
+            rtrain.report({"epoch": epoch},
+                          checkpoint=Checkpoint({"epoch": epoch}))
+    return loop
+
+
+def _run_fit(run_name: str, kill: bool) -> dict:
+    from ray_tpu.train import ElasticTrainer, FailureConfig, ScalingConfig
+
+    killed = threading.Event()
+
+    def killer():
+        import signal
+
+        from ray_tpu.api import _get_runtime
+        pool = _get_runtime().raylet.pool
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with pool._lock:
+                busy = [h for h in pool._workers
+                        if not h.dead and h.dedicated]
+            if len(busy) >= 2:
+                time.sleep(1.0)     # let the gang get into an epoch
+                try:
+                    os.kill(busy[0].proc.pid, signal.SIGKILL)
+                    killed.set()
+                except OSError:     # won the race with completion
+                    pass
+                return
+            time.sleep(0.1)
+
+    th = None
+    if kill:
+        th = threading.Thread(target=killer, daemon=True)
+        th.start()
+    t = ElasticTrainer(
+        _epoch_loop(LIVE_EPOCHS, LIVE_EPOCH_S),
+        scaling_config=ScalingConfig(num_workers=2, min_workers=1),
+        failure_config=FailureConfig(max_failures=0),
+        run_name=run_name)
+    t0 = time.perf_counter()
+    res = t.fit(timeout=180)
+    wall = time.perf_counter() - t0
+    if th is not None:
+        th.join(timeout=30)
+    st = t.stats()
+    epochs = [r["epoch"] for r in res.history]
+    return {
+        "wall_s": round(wall, 2),
+        "final_epoch": res.metrics["epoch"],
+        "gang_losses": st["gang_losses"],
+        "failures": st["failures"],
+        "ckpt_replications": st.get("ckpt_replications", 0),
+        "epochs_monotone": epochs == sorted(epochs),
+        "kill_landed": killed.is_set(),
+    }
+
+
+def live_sigkill_bench() -> dict:
+    """A real gang, one member SIGKILLed mid-allreduce, vs an unkilled
+    control: the membership loss must cost recovery time, never
+    progress or a ``max_failures`` budget unit."""
+    control = _run_fit("bench-train-control", kill=False)
+    chaos = _run_fit("bench-train-sigkill", kill=True)
+    slo = {
+        "kill_landed": chaos["kill_landed"],
+        "completed": chaos["final_epoch"] == LIVE_EPOCHS,
+        "gang_loss_typed": chaos["gang_losses"] >= 1,
+        "zero_failure_burn": chaos["failures"] == 0,
+        "epochs_monotone": chaos["epochs_monotone"],
+    }
+    return {"control": control, "sigkill": chaos,
+            "recovery_overhead_s": round(
+                chaos["wall_s"] - control["wall_s"], 2),
+            "slo": slo, "slo_pass": all(slo.values())}
+
+
+def main():
+    # invariant: the SLO record exists before anything can hang
+    diurnal = _smoke_first()
+
+    if os.environ.get("RT_BENCH_FORCE_SKIP") == "1":
+        print(json.dumps({
+            "metric": "train live sigkill SKIPPED "
+                      "(RT_BENCH_FORCE_SKIP)",
+            "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+            "status": "skipped"}), flush=True)
+        _write_record(diurnal, live={"status": "skipped"})
+        return
+
+    import ray_tpu
+    live = None
+    err = ""
+    try:
+        # tight collective timeout at INIT so pre-spawned pool workers
+        # bake it in: the SIGKILLed peer must surface in seconds
+        ray_tpu.init(resources={"CPU": 8, "memory": 8}, num_workers=4,
+                     system_config={"train_collective_timeout_s": 8.0})
+        live = live_sigkill_bench()
+    except Exception as e:   # noqa: BLE001 — record, don't die
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:   # noqa: BLE001
+            pass
+
+    _write_record(diurnal, live, error=err)
+    if live is None:
+        print(json.dumps({
+            "metric": f"train live sigkill FAILED [{err[:200]}]",
+            "value": -1.0, "unit": "x", "vs_baseline": 0.0,
+            "status": "live_failed"}), flush=True)
+        return
+    ch, ct = live["sigkill"], live["control"]
+    print(json.dumps({
+        "metric": f"train live: SIGKILL mid-allreduce recovered in "
+                  f"+{live['recovery_overhead_s']}s over the "
+                  f"{ct['wall_s']}s control — {ch['gang_losses']} gang "
+                  f"loss, {ch['failures']} failures burned, epoch "
+                  f"{ch['final_epoch']}/{LIVE_EPOCHS} committed"
+                  + ("" if live["slo_pass"] else " [LIVE SLO FAIL]"),
+        "value": live["recovery_overhead_s"],
+        "unit": "s",
+        "vs_baseline": 1.0 if live["slo_pass"] else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        _emit_smoke()
+    else:
+        main()

@@ -395,6 +395,48 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "Reclaim drain deadline: a loaner replica still busy past this "
         "is force-killed so the node returns to the batch pool (the "
         "DRAINING machine's preemption-notice semantics)."),
+    # -- collective process groups (util/collective.py) ----------------------
+    "collective_timeout_s": (
+        float, 60.0,
+        "Default deadline for process-group collective ops (allreduce/"
+        "allgather/reducescatter/broadcast/barrier/send/recv).  A gang "
+        "peer SIGKILLed between barrier and reduce leaves the round "
+        "incomplete forever; past this deadline the op raises "
+        "GangMemberLost naming the missing ranks so the trainer can "
+        "re-form the gang from the last journaled step instead of "
+        "hanging.  Per-call timeout= overrides."),
+    # -- elastic training plane (train/elastic.py + sim/train.py) ------------
+    "train_epoch_s": (
+        float, 20.0,
+        "Virtual seconds one simulated training epoch takes at full "
+        "gang strength (SimTrainPlane); partial epochs lost to gang "
+        "re-forms are the goodput cost the train_diurnal bench "
+        "measures."),
+    "train_ckpt_replicas": (
+        int, 2,
+        "Checkpoint copy target: an epoch is acked only once its "
+        "checkpoint object has this many replicas on distinct live "
+        "nodes (the writer plus replication peers), and the plane "
+        "re-replicates from a surviving copy when a holder dies — the "
+        "ckpt-durable invariant fires on a sole copy that persists "
+        "past the replication grace."),
+    "train_ckpt_replicate_s": (
+        float, 2.0,
+        "Virtual seconds one checkpoint replica copy takes in the "
+        "simulator (and the grace unit the ckpt-durable invariant "
+        "allows a sole copy before firing)."),
+    "train_borrow_max": (
+        int, 2,
+        "Maximum serve replicas the training plane may borrow "
+        "concurrently (the Aryl reverse direction: train borrows FROM "
+        "serve at the diurnal trough, returned with drain semantics "
+        "when serve pressure comes back); 0 disables borrowing."),
+    "train_collective_timeout_s": (
+        float, 15.0,
+        "Virtual seconds a simulated gang blocks on a collective after "
+        "a member SIGKILL before declaring GangMemberLost and "
+        "re-forming from the last journaled epoch (the sim twin of "
+        "collective_timeout_s, scaled to virtual epochs)."),
     # -- model-version plane (ray_tpu/versioning/) --------------------------
     "rollout_flip_drain_timeout_s": (
         float, 30.0,

@@ -404,6 +404,7 @@ class HeadNode:
             "jobs": self.jobs.list(),
             "drains": cluster.drain_status(),
             "serve": self._serve_stats(),
+            "train": self._train_stats(cluster),
             "versions": self._version_stats(),
             "health": self._health_stats(cluster),
             "chaos": self._chaos_stats(),
@@ -416,6 +417,26 @@ class HeadNode:
             return aggregate_stats()
         except Exception:   # noqa: BLE001 — lease plane disabled
             return {}
+
+    @staticmethod
+    def _train_stats(cluster) -> dict:
+        # elastic training plane: driver-local run gauges plus the
+        # loan manager's two-directional lending counters
+        out: dict = {}
+        try:
+            from ..train.elastic import active_train_stats
+            runs = active_train_stats()
+            if runs:
+                out["runs"] = runs
+        except Exception:   # noqa: BLE001 — train plane unused
+            pass
+        loans = getattr(cluster, "loans", None)
+        if loans is not None:
+            try:
+                out["loans"] = loans.stats()
+            except Exception:   # noqa: BLE001
+                pass
+        return out
 
     @staticmethod
     def _health_stats(cluster) -> dict:

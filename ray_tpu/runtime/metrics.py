@@ -277,6 +277,53 @@ def render_metrics(cluster) -> str:
         _fmt("serve_loan_last_reclaim_seconds",
              ls["last_reclaim_latency_s"],
              "Drain-to-restore latency of the last reclaim", out=out)
+        _fmt("reverse_lends_active", ls.get("reverse_lends_active", 0),
+             "Serve nodes currently lent to batch/train", out=out)
+        _fmt("reverse_lends_total", ls.get("reverse_lends_total", 0),
+             "Reverse lends taken (cumulative)", out=out)
+        _fmt("reverse_lends_returned_total",
+             ls.get("reverse_lends_returned", 0),
+             "Reverse lends ended by serve pressure (cumulative)",
+             out=out)
+        _fmt("reverse_lends_lost_total",
+             ls.get("reverse_lends_lost", 0),
+             "Lent nodes lost to failure, booked once (cumulative)",
+             out=out)
+
+    # elastic training plane (driver-local ElasticTrainer runs)
+    try:
+        from ..train.elastic import active_train_stats
+        runs = active_train_stats()
+    except Exception:   # noqa: BLE001 — train plane unused
+        runs = []
+    for ts in runs:
+        lbl = {"run": ts.get("run", "")}
+        _fmt("train_epoch", ts.get("epoch") or 0,
+             "Last journaled (acked) epoch of the run", labels=lbl,
+             out=out)
+        _fmt("train_gang_losses_total", ts.get("gang_losses", 0),
+             "Gang members lost mid-collective (cumulative)",
+             labels=lbl, out=out)
+        _fmt("train_planned_resizes_total",
+             ts.get("planned_resizes", 0),
+             "Drain/loan-reclaim restarts, no failure burn "
+             "(cumulative)", labels=lbl, out=out)
+        _fmt("train_failures_total", ts.get("failures", 0),
+             "Unexplained gang failures charged to max_failures "
+             "(cumulative)", labels=lbl, out=out)
+        _fmt("train_world_size", ts.get("world", 0),
+             "Current gang world size", labels=lbl, out=out)
+        _fmt("train_sync_broadcasts_total",
+             ts.get("sync_broadcasts", 0),
+             "Checkpoint fan-outs over the broadcast tree "
+             "(cumulative)", labels=lbl, out=out)
+        _fmt("train_ckpt_replications_total",
+             ts.get("ckpt_replications", 0),
+             "Checkpoint replication rounds off the writer "
+             "(cumulative)", labels=lbl, out=out)
+        _fmt("train_goodput_eps", ts.get("goodput_eps", 0.0),
+             "Acked epochs per wall second of fit(), recovery "
+             "stalls included", labels=lbl, out=out)
 
     # lease plane (process-local registry: agent cache, head grantor,
     # standby — whichever roles live in this process)

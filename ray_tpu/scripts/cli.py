@@ -313,6 +313,28 @@ def cmd_status(args) -> int:
                 line += (f" batches={s['batches']}"
                          f"(mean={s['batch_size_mean']})")
             print(line)
+    tr = st.get("train") or {}
+    for run in tr.get("runs") or []:
+        print(f"train run {run.get('run')}: state={run.get('state')} "
+              f"epoch={run.get('epoch')} step={run.get('step')} "
+              f"world={run.get('world', 0)} "
+              f"goodput_eps={run.get('goodput_eps', 0.0)} "
+              f"gang_losses={run.get('gang_losses', 0)} "
+              f"planned_resizes={run.get('planned_resizes', 0)} "
+              f"failures={run.get('failures', 0)} "
+              f"sync_broadcasts={run.get('sync_broadcasts', 0)} "
+              f"ckpt_replications={run.get('ckpt_replications', 0)}")
+    tl = tr.get("loans") or {}
+    if tl.get("loans_total") or tl.get("reverse_lends_total"):
+        print(f"capacity loans: serve<-batch "
+              f"active={tl.get('loans_active', 0)} "
+              f"total={tl.get('loans_total', 0)} "
+              f"reclaimed={tl.get('reclaims_total', 0)} "
+              f"lost={tl.get('loans_lost', 0)}  |  batch<-serve "
+              f"active={tl.get('reverse_lends_active', 0)} "
+              f"total={tl.get('reverse_lends_total', 0)} "
+              f"returned={tl.get('reverse_lends_returned', 0)} "
+              f"lost={tl.get('reverse_lends_lost', 0)}")
     versions = st.get("versions") or {}
     if versions:
         print(f"model versions ({len(versions)}):")

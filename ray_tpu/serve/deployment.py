@@ -257,6 +257,7 @@ class _Controller:
         self._replicas: list = []
         self._loaners: list = []    # replicas on LOANED batch nodes
         self._retiring: list = []   # loaners draining for reclaim
+        self._releasing: list = []  # replicas draining for a reverse lend
         self._flipping: list = []   # replicas out of routing mid-flip
         self._version = 0
         self._model_version = "v1"  # the deployment's SERVING version
@@ -309,6 +310,7 @@ class _Controller:
                     # regular pool cannot grow past its configured cap
                     "at_max": len(self._replicas) >= hi,
                     "loaners": len(self._loaners),
+                    "releasing": len(self._releasing),
                     # model-version plane: per-replica version tags so
                     # routers can pin sessions to a consistent version
                     # while a rollout is mid-flight
@@ -370,6 +372,47 @@ class _Controller:
                     pass
                 return True
         return False
+
+    # -- reverse lending (batch/train borrows a serve node) ------------------
+    def begin_release_replica(self):
+        """Reverse-lend step 1: lend one regular replica's node to
+        batch/train — pull the newest replica out of routing (version
+        bump -> shards stop dispatching) but keep it alive to finish
+        in-flight work; the loan manager kills it once idle via
+        ``finish_release_replica``, freeing the node for batch
+        placement.  Refuses to shrink below the autoscaling floor."""
+        auto = self._autoscaling
+        lo = max(auto.get("min_replicas", 1) if auto else 1, 1)
+        if len(self._replicas) <= lo:
+            return None
+        pick = self._replicas[-1]               # LIFO, like loan reclaim
+        self._replicas.remove(pick)
+        self._releasing.append(pick)
+        self._version += 1
+        return pick
+
+    def finish_release_replica(self, key_hex: str) -> bool:
+        """Reverse-lend step 2: the drain converged (or the node died)
+        — kill the released replica; its resources return to the CRM
+        and batch placement can use the whole node."""
+        import ray_tpu
+        for h in list(self._releasing):
+            if h._actor_id.binary().hex() == key_hex:
+                self._releasing.remove(h)
+                self._replica_versions.pop(key_hex, None)
+                try:
+                    ray_tpu.kill(h)
+                except Exception:   # noqa: BLE001 — already dead
+                    pass
+                self._version += 1
+                return True
+        return False
+
+    def restore_replica(self) -> None:
+        """Reverse-lend epilogue: the lend ended (serve pressure came
+        back, or the lent node died) — start a fresh replica to take
+        the lent one's place in the pool."""
+        self._start_replica()
 
     # -- model-version plane (versioning/rollout.py calls these) -------------
     def begin_flip(self, key_hex: str) -> bool:

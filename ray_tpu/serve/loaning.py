@@ -24,6 +24,17 @@ controller drops the dead replica from its membership, and the router's
 transport-error path settles the in-flight accounting (the next gossip
 fold evicts the dead replica's digest).
 
+Loaning also runs in REVERSE: when batch/train demand is unmet, no idle
+batch row exists, and a deployment is quiet, the manager borrows a
+serve node — ``begin_release_replica`` pulls the newest replica out of
+routing (same drain semantics as a reclaim), the manager polls its
+in-flight count to zero, then ``finish_release_replica`` kills it so
+the node's full availability returns to the CRM for batch placement.
+Serve backlog pressure ends the lend (``restore_replica`` starts a
+fresh replica); a lent node that dies is booked as a loss exactly once
+by the same popped-record rule, and serve is made whole with a
+replacement replica elsewhere.
+
 Ticks ride existing beats — the autoscaler's ``update()`` round (which
 also supplies batch pressure as ``unmet``) and the health manager's
 probe round — so loaning adds no thread and no new RPC.
@@ -67,6 +78,22 @@ class _Loan:
         self.drain_deadline = 0.0
 
 
+class _ReverseLend:
+    __slots__ = ("node_id", "row", "handle", "key_hex", "ctl_key",
+                 "controller", "state", "t_start", "drain_deadline")
+
+    def __init__(self, node_id, row, handle, ctl_key, controller):
+        self.node_id = node_id
+        self.row = row
+        self.handle = handle            # the released replica's handle
+        self.key_hex = handle._actor_id.binary().hex()
+        self.ctl_key = ctl_key
+        self.controller = controller
+        self.state = "draining"         # draining -> lent -> (gone)
+        self.t_start = _clk.monotonic()
+        self.drain_deadline = 0.0
+
+
 class CapacityLoanManager:
     """Tracks LOANED rows atop the CRM and drives the loan/reclaim
     state machine.  Driver-side: it reads the driver-local router
@@ -76,11 +103,15 @@ class CapacityLoanManager:
         self._cluster = cluster
         self._lock = threading.Lock()
         self._loans: list[_Loan] = []
+        self._rloans: list[_ReverseLend] = []
         self._cooldown_until = 0.0
         self._serve_idle: dict[bytes, float] = {}   # ctl_key -> since
         self.loans_total = 0
         self.reclaims_total = 0
         self.loans_lost = 0
+        self.reverse_lends_total = 0
+        self.reverse_lends_returned = 0
+        self.reverse_lends_lost = 0
         self.last_reclaim_latency_s = 0.0
 
     # -- the tick (autoscaler round / health probe round) --------------------
@@ -93,8 +124,11 @@ class CapacityLoanManager:
         try:
             self._book_deaths()
             self._advance_reclaims()
+            self._advance_releases()
             self._start_reclaims(unmet)
+            self._end_stale_releases()
             self._maybe_loan()
+            self._maybe_release(unmet)
         finally:
             self._lock.release()
 
@@ -119,6 +153,24 @@ class CapacityLoanManager:
             self._cluster.events.emit(
                 "loans", "loan_lost", node_row=loan.row,
                 node_id=loan.node_id.hex(), state=loan.state)
+        for rl in list(self._rloans):
+            if rl.node_id is None or crm.row_of(rl.node_id) is not None:
+                continue
+            # same exactly-once rule: popping the record IS the booking
+            self._rloans.remove(rl)
+            self.reverse_lends_lost += 1
+            try:
+                if rl.state == "draining":
+                    _api().get(rl.controller.finish_release_replica.remote(
+                        rl.key_hex), timeout=10)
+                # serve is made whole with a replacement elsewhere
+                _api().get(rl.controller.restore_replica.remote(),
+                           timeout=10)
+            except Exception:   # noqa: BLE001 — controller may be gone too
+                pass
+            self._cluster.events.emit(
+                "loans", "reverse_lend_lost", node_row=rl.row,
+                node_id=rl.node_id.hex(), state=rl.state)
 
     # -- reclaim state machine -----------------------------------------------
     def _start_reclaims(self, unmet: int) -> None:
@@ -209,6 +261,121 @@ class CapacityLoanManager:
                          ResourceRequest.from_cu_dict(loan.borrowed))
         crm.set_loaned(loan.row, False)
         self._cluster.wake_raylets()    # parked batch work fits again
+
+    # -- reverse lend state machine ------------------------------------------
+    def _advance_releases(self) -> None:
+        """Poll draining released replicas; once in-flight hits zero
+        (or the drain deadline passes) kill the replica — the node's
+        availability returns to the CRM and batch placement fits."""
+        from ray_tpu.actor_api import ActorMethod
+        for rl in list(self._rloans):
+            if rl.state != "draining":
+                continue
+            active = 0
+            try:
+                active = _api().get(
+                    ActorMethod(rl.handle, "_active_count").remote(),
+                    timeout=5)
+            except Exception:   # noqa: BLE001 — unreachable counts as done
+                active = 0
+            if active > 0 and _clk.monotonic() < rl.drain_deadline:
+                continue
+            try:
+                _api().get(rl.controller.finish_release_replica.remote(
+                    rl.key_hex), timeout=10)
+            except Exception:   # noqa: BLE001 — death path books it next beat
+                continue
+            rl.state = "lent"
+            self._cluster.wake_raylets()    # parked batch work fits now
+            self._cluster.events.emit(
+                "loans", "reverse_lend_active", node_row=rl.row,
+                node_id=rl.node_id.hex() if rl.node_id else "")
+
+    def _end_stale_releases(self) -> None:
+        """Serve wants its capacity back: a deployment whose replica is
+        out on a reverse lend built up backlog — end the lend (a fresh
+        replica replaces the lent one)."""
+        if not self._rloans:
+            return
+        cfg = get_config()
+        bar = max(1, cfg.serve_loan_backlog // 2)
+        pressured = set()
+        for group in self._groups():
+            queued, _inflight, _ewma = group.backlog()
+            if queued >= bar:
+                pressured.add(group._controller._actor_id.binary())
+        for rl in reversed(list(self._rloans)):     # LIFO: newest first
+            if rl.ctl_key in pressured:
+                self._end_release(rl)
+
+    def _end_release(self, rl: _ReverseLend) -> None:
+        # reclaim notice BEFORE the replica returns: batch/train work
+        # on the lent row (the elastic trainer's gang) vacates as a
+        # PLANNED resize, making room for the restored replica
+        try:
+            self._cluster.pubsub.publish(
+                "node", {"event": "loan_reclaim", "row": rl.row,
+                         "node_id": rl.node_id.hex() if rl.node_id
+                         else ""})
+        except Exception:   # noqa: BLE001 — notice is best-effort
+            pass
+        try:
+            if rl.state == "draining":
+                _api().get(rl.controller.finish_release_replica.remote(
+                    rl.key_hex), timeout=10)
+            _api().get(rl.controller.restore_replica.remote(), timeout=10)
+        except Exception:   # noqa: BLE001 — death path books it next beat
+            return
+        self._rloans.remove(rl)
+        self.reverse_lends_returned += 1
+        self._cluster.events.emit(
+            "loans", "reverse_lend_returned", node_row=rl.row,
+            node_id=rl.node_id.hex() if rl.node_id else "")
+
+    def _maybe_release(self, unmet: int) -> None:
+        """Reverse direction: batch/train demand is unmet, no idle
+        batch row exists to loan the normal way, and a deployment is
+        quiet — borrow a serve node by releasing its newest replica."""
+        cfg = get_config()
+        now = _clk.monotonic()
+        if unmet <= 0 or now < self._cooldown_until:
+            return
+        if self._loans or len(self._rloans) >= cfg.train_borrow_max:
+            return      # never both directions at once
+        if self._pick_idle_row() is not None:
+            return      # plain batch capacity exists; no need to raid serve
+        for group in self._groups():
+            queued, _inflight, _ewma = group.backlog()
+            if queued > 0:
+                continue
+            controller = group._controller
+            try:
+                handle = _api().get(
+                    controller.begin_release_replica.remote(), timeout=10)
+            except Exception:   # noqa: BLE001
+                continue
+            if handle is None:
+                continue        # at the autoscaling floor
+            row = self._row_of_handle(handle)
+            node_id = self._cluster.crm.id_of(row) if row >= 0 else None
+            rl = _ReverseLend(node_id, row, handle,
+                              controller._actor_id.binary(), controller)
+            rl.drain_deadline = now + cfg.serve_loan_drain_timeout_s
+            self._rloans.append(rl)
+            self.reverse_lends_total += 1
+            self._cooldown_until = now + cfg.serve_loan_cooldown_s
+            self._cluster.events.emit(
+                "loans", "reverse_lend_started", node_row=row,
+                node_id=node_id.hex() if node_id else "",
+                deployment=group.cfg().get("name", ""))
+            return              # at most one lend per tick
+
+    def _row_of_handle(self, handle) -> int:
+        am = getattr(self._cluster, "actor_manager", None)
+        if am is None:
+            return -1
+        rec = am._actors.get(handle._actor_id)
+        return rec.row if rec is not None else -1
 
     # -- loan path ------------------------------------------------------------
     def _maybe_loan(self) -> None:
@@ -307,4 +474,8 @@ class CapacityLoanManager:
                 "reclaims_total": self.reclaims_total,
                 "loans_lost": self.loans_lost,
                 "loans_active": len(self._loans),
+                "reverse_lends_total": self.reverse_lends_total,
+                "reverse_lends_returned": self.reverse_lends_returned,
+                "reverse_lends_lost": self.reverse_lends_lost,
+                "reverse_lends_active": len(self._rloans),
                 "last_reclaim_latency_s": self.last_reclaim_latency_s}

@@ -34,6 +34,12 @@ Load accounting feeds the ``_Controller`` autoscaler through GCS KV:
   (replica shell) — or by the router itself when the completion is a
   TRANSPORT error (dead replica): the shell never ran, so the router
   must settle the counter or the backlog signal inflates forever.
+  Requests whose replica DIED are then failed over — dead replica
+  evicted from the shard view, request re-routed — so a stale view
+  window (replica release, loan reclaim, crash) degrades to a retry,
+  not a caller-visible ActorDiedError.  Driver-side requests are ALL
+  promise-backed (even the unsaturated fast path) precisely so this
+  retry has a promise to re-point.
 - ``queued-<base>``    +1 at enqueue, -1 at dispatch/expiry/shed.
 - ``lat-<base>``       request-latency EWMA (ms), written by the router
   on every completion; the autoscaler and ``serve.status`` read it.
@@ -374,8 +380,16 @@ class RequestRouter:
             else:
                 return self._enqueue_locked(method, args, kwargs, mux,
                                             deadline)
-        return self._dispatch(replica, method, args, kwargs, mux,
-                              promise=None)
+        # even the fast path hands back a PROMISE ref, never the raw
+        # submit ref: a replica that dies under a stale view (release,
+        # loan reclaim, crash) then re-routes invisibly instead of
+        # surfacing ActorDiedError to a caller who picked nothing
+        from ray_tpu.common.ids import ObjectID
+        from ray_tpu.runtime.object_ref import ObjectRef
+        promise = ObjectRef(ObjectID.from_random())
+        self._dispatch(replica, method, args, kwargs, mux,
+                       promise=promise)
+        return promise
 
     def _enqueue_locked(self, method, args, kwargs, mux, deadline):
         """All replicas saturated: park the request (bounded) and return
@@ -399,14 +413,17 @@ class RequestRouter:
         item = _Queued(method, args, kwargs, mux, deadline, ref)
         self._queue.append(item)
         self._kv(b"queued-" + self._kv_base.encode(), 1)
+        self._ensure_dispatcher_locked()
+        self._cv.notify_all()
+        return ref
+
+    def _ensure_dispatcher_locked(self) -> None:
         if self._dispatcher is None or not self._dispatcher.is_alive():
             self._dispatcher = threading.Thread(
                 target=self._dispatch_loop, daemon=True,
                 name=(f"serve-router-{self._cfg.get('name', '?')}"
                       f"-s{self._shard_id}"))
             self._dispatcher.start()
-        self._cv.notify_all()
-        return ref
 
     def _submit_call(self, replica, method, args, kwargs, mux,
                      streaming: bool = False):
@@ -439,13 +456,19 @@ class RequestRouter:
                 raise
             self._poison(promise, err)
             return None
-        self._watch(rkey, ref, promise)
+        self._watch(rkey, ref, promise, (method, args, kwargs, mux))
         return ref
 
-    def _watch(self, replica_key: bytes, ref, promise) -> None:
+    def _watch(self, replica_key: bytes, ref, promise,
+               request=None) -> None:
         """Completion observer: frees the replica slot, classifies the
         result (transport errors settle the shell's KV debt), records
-        latency, and fulfills the promise for queued requests."""
+        latency, and fulfills the promise for queued requests.  A
+        replica-death completion on a promise fails OVER instead of
+        failing the request: the dead replica is evicted from the local
+        view and the request re-routed to a live one (membership
+        changed under a stale view — planned releases and loan reclaims
+        land here)."""
         store = self._driver_store()
         t0 = _now()
 
@@ -463,16 +486,57 @@ class RequestRouter:
                 self._kv(self._kv_inflight, -1)
                 with self._stats.lock:
                     self._stats.transport_errors += 1
+                self._release(replica_key)
+                # cancellation is deliberate — surface it; replica
+                # DEATH evicts the stale view entry immediately (the
+                # next pick skips the corpse) and re-routes the request
+                # (at-least-once, matching upstream serve's
+                # retry-on-replica-failure)
+                if isinstance(err.cause,
+                              (ActorDiedError, WorkerCrashedError)):
+                    self._evict_dead(replica_key)
+                    if promise is not None and request is not None:
+                        self._redispatch(promise, request)
+                        return
             else:
                 from ray_tpu.common.config import get_config
                 alpha = get_config().serve_latency_ewma_alpha
                 ewma = self._stats.record_completion(
                     lat_ms, alpha, user_error=err is not None)
                 self._write_latency(ewma)
-            self._release(replica_key)
+                self._release(replica_key)
             if promise is not None:
                 self._fulfill(promise, ref)
         store.on_ready(ref.id, done)
+
+    def _evict_dead(self, replica_key: bytes) -> None:
+        """Drop a dead replica from the local routing view NOW — the
+        transport error proves it is gone; waiting for the periodic
+        refresh would keep landing requests on it."""
+        with self._cv:
+            self._replicas = [r for r in self._replicas
+                              if r._actor_id.binary() != replica_key]
+            self._inflight.pop(replica_key, None)
+            self._cv.notify_all()
+
+    def _redispatch(self, promise, request) -> None:
+        """Re-route a request whose replica died before running it:
+        straight to a free live replica, or parked with its EXISTING
+        promise ref for the dispatcher thread.  Each hop evicts a dead
+        replica first, so the fail-over chain is bounded by the view."""
+        method, args, kwargs, mux = request
+        with self._cv:
+            replica = self._pick_locked(mux)
+            if replica is not None:
+                self._acquire_locked(replica)
+            else:
+                self._queue.append(_Queued(method, args, kwargs, mux,
+                                           None, promise))
+                self._kv(b"queued-" + self._kv_base.encode(), 1)
+                self._ensure_dispatcher_locked()
+                self._cv.notify_all()
+                return
+        self._dispatch(replica, method, args, kwargs, mux, promise)
 
     def _write_latency(self, ewma_ms: float) -> None:
         from ray_tpu.experimental.internal_kv import _internal_kv_put

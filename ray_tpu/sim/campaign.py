@@ -34,7 +34,7 @@ __all__ = ["CAMPAIGNS", "CampaignResult", "run_campaign",
 CAMPAIGNS = ("mixed", "rolling_kill", "partitions", "gray_slow",
              "drain_churn", "autoscaler_flap", "broadcast_storm",
              "serve_diurnal", "head_failover_storm",
-             "serve_rolling_update")
+             "serve_rolling_update", "train_diurnal")
 
 # the failover storm snaps task durations to a small class set so the
 # job stream is a repeat-class workload — the shape the lease plane's
@@ -150,6 +150,13 @@ def build_schedule(campaign: str, rng, num_nodes: int, faults: int,
         "serve_rolling_update": (("rollout", 0.25), ("kill_node", 0.35),
                                  ("gray_slow", 0.15), ("drain", 0.15),
                                  ("kill_head", 0.1)),
+        # train + serve sharing one pool under a diurnal day: rolling
+        # SIGKILLs land on gang members, serve replicas and borrowed
+        # rows, a head kill lands mid-epoch, drains force planned
+        # resizes — loans must flow BOTH directions and acked epochs
+        # must never regress
+        "train_diurnal": (("kill_node", 0.5), ("drain", 0.2),
+                          ("gray_slow", 0.15), ("kill_head", 0.15)),
     }
     ops, weights = zip(*mixes[campaign])
     sched = []
@@ -231,6 +238,7 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                  params: SimParams | None = None,
                  autoscale: bool = True, lock_order: bool = False,
                  serve: dict | None = None,
+                 train: dict | None = None,
                  out: str | None = None, progress=None,
                  schedule: list | None = None,
                  coverage=None) -> CampaignResult:
@@ -269,7 +277,9 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         cluster.trace.cov = coverage
     plane = None
     rplane = None
-    if campaign in ("serve_diurnal", "serve_rolling_update"):
+    tplane = None
+    if campaign in ("serve_diurnal", "serve_rolling_update",
+                    "train_diurnal"):
         from .serve import SimServePlane
         plane = SimServePlane(cluster, seed=seed, duration=duration,
                               **(serve or {}))
@@ -277,6 +287,11 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
     if campaign == "serve_rolling_update":
         from .rollout import SimRolloutPlane
         rplane = SimRolloutPlane(cluster, plane)
+    if campaign == "train_diurnal":
+        from .train import SimTrainPlane
+        tplane = SimTrainPlane(cluster, duration=duration,
+                               serve=plane, **(train or {}))
+        cluster.train_plane = tplane
     if lock_order:
         from ..common import lockorder
         if not lockorder.installed():
@@ -330,6 +345,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                     w.on_node_killed(kw["node"])
                 if plane is not None:
                     plane.on_node_killed(kw["node"])
+                if tplane is not None:
+                    tplane.on_node_killed(kw["node"])
             trace.rec(t, "fault", op=op, node=kw["node"], hit=hit)
         elif op == "rollout":
             rid = ""
@@ -381,6 +398,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                     max_nodes=num_nodes + max(8, num_nodes // 10))
             if plane is not None:
                 plane.start()
+            if tplane is not None:
+                tplane.start()
             for t, jid, tasks in jobs:
                 clock.call_later(
                     t, lambda jid=jid, tasks=tasks: submit(jid, tasks))
@@ -414,7 +433,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
                 return done == len(acked) and \
                     all(w.terminal for w in waves) and \
                     (plane is None or plane.terminal) and \
-                    (rplane is None or rplane.all_terminal)
+                    (rplane is None or rplane.all_terminal) and \
+                    (tplane is None or tplane.terminal)
 
             settle_end = duration + _SETTLE_CAP_S
             while not all_done() and clock.monotonic() < settle_end:
@@ -445,6 +465,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
         result.stats["serve"] = plane.stats()
     if rplane is not None:
         result.stats["rollout"] = rplane.stats()
+    if tplane is not None:
+        result.stats["train"] = tplane.stats()
     if out:
         write_artifact(out, result, trace, duration, faults,
                        schedule=schedule, params=cluster.params)
@@ -455,7 +477,7 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
 # resolved values reproduction depends on, so a replay is a pure
 # function of the artifact, never of the ambient env
 _KNOB_PREFIXES = ("chaos_", "lease_", "serve_", "sim_", "standby_",
-                  "rollout_", "version_",
+                  "rollout_", "version_", "train_", "collective_",
                   "rpc_breaker_", "rtlint_runtime_lock_order")
 
 

@@ -59,6 +59,18 @@ _HEAD_TOUCH_US = 1.0            # heartbeat / lease-row liveness touch
 _HEAD_ITEM_US = 5.0             # marginal cost per batched item
 
 
+def _row_reserved(cluster, nid: str) -> bool:
+    """A row held by an overlay plane (serve replica, loaned-out row,
+    or a training-gang member) is off the batch market: the scheduler
+    never places on it, the lease plane prices it at zero headroom and
+    the autoscaler neither counts it as slack nor idle-drains it."""
+    plane = cluster.serve_plane
+    if plane is not None and nid in plane.reserved:
+        return True
+    tplane = cluster.train_plane
+    return tplane is not None and nid in tplane.reserved
+
+
 class Trace:
     """Append-only campaign trace with an incremental sha256 over the
     canonical JSON of every event — the replay fingerprint.  Storage is
@@ -767,7 +779,6 @@ class SimHead:
 
     # -- scheduling ----------------------------------------------------------
     def _pick_node(self) -> str | None:
-        plane = self.cluster.serve_plane
         for allow_suspect in (False, True):     # soft-avoid: two passes
             n = len(self._node_order)
             for off in range(n):
@@ -775,8 +786,8 @@ class SimHead:
                 row = self.nodes.get(nid)
                 if row is None or row["state"] != ALIVE:
                     continue
-                if plane is not None and nid in plane.reserved:
-                    continue    # serve replica or LOANED: off the market
+                if _row_reserved(self.cluster, nid):
+                    continue    # serve replica, gang member or LOANED
                 if row["suspect"] and not allow_suspect:
                     continue
                 if len(row["running"]) + len(row["leased"]) >= \
@@ -816,8 +827,7 @@ class SimHead:
         row = self.nodes.get(nid)
         if row is None or row["state"] != ALIVE or row["suspect"]:
             return 0
-        plane = self.cluster.serve_plane
-        if plane is not None and nid in plane.reserved:
+        if _row_reserved(self.cluster, nid):
             return 0
         cap = int(self.params.node_capacity *
                   self.params.lease_overcommit)
@@ -1215,15 +1225,14 @@ class SimAutoscaler:
         if head is not None and head.alive:
             p = cl.params
             now = cl.clock.monotonic()
-            plane = cl.serve_plane
             alive = []
             free = 0
             for nid in head._node_order:
                 row = head.nodes.get(nid)
                 if row is not None and row["state"] == ALIVE:
                     alive.append(nid)
-                    if plane is not None and nid in plane.reserved:
-                        continue    # serve/LOANED rows add no batch slack
+                    if _row_reserved(cl, nid):
+                        continue    # serve/gang/LOANED: no batch slack
                     if not row["suspect"]:
                         free += p.node_capacity - len(row["running"])
             pending = len(head.pending)
@@ -1245,8 +1254,8 @@ class SimAutoscaler:
                 for nid in alive:
                     if drained >= min(2, surplus):  # gentle: <=2/tick
                         break
-                    if plane is not None and nid in plane.reserved:
-                        continue    # never idle-drain a serve replica
+                    if _row_reserved(cl, nid):
+                        continue    # never idle-drain serve/gang rows
                     row = head.nodes[nid]
                     if not row["running"] and \
                             now - row["idle_since"] > \
@@ -1281,6 +1290,7 @@ class SimCluster:
         self.head: SimHead | None = None
         self.autoscaler: SimAutoscaler | None = None
         self.serve_plane = None     # installed by serve_diurnal campaigns
+        self.train_plane = None     # installed by train_diurnal campaigns
         # lease plane + failover bookkeeping (cluster-scoped so it
         # survives head kills; the promoted head keeps accruing)
         self.head_busy_us = 0.0

@@ -65,6 +65,17 @@ The invariants, and the machinery each one proves:
   local cache actually admits).  Nodes mid-revocation (cache epoch
   behind the grantor's) and classes the grantor LRU-evicted (eviction
   does not bump the epoch) are out of scope.
+- **goodput-accounting** / **ckpt-durable** / **gang-terminal**
+  (r19) — the training plane (when a ``train_diurnal`` campaign
+  installed one): committed samples, the KV epoch journal and the
+  acked-epoch counter agree, and acked epochs never regress (the
+  journal is written only after checkpoint replication, so a head kill
+  or standby promotion can stall an ack but never roll one back); the
+  newest acked checkpoint always holds a live copy and re-replicates
+  to ``train_ckpt_replicas`` within the replication grace after a
+  copy-holder dies; strictly, training reaches its terminal state by
+  quiesce with every borrowed serve row returned and every
+  reservation released.
 - **version-mixed-session** / **rollout-terminal** /
   **old-version-retained** (r18) — the model-version plane (when a
   ``serve_rolling_update`` campaign installed one): no accepted
@@ -114,6 +125,10 @@ INVARIANTS = {
         "no request served off its session's pinned model version",
     "rollout-terminal": "strict final: every rollout SEALED/ROLLED_BACK",
     "old-version-retained": "old weights retained until the seal",
+    "goodput-accounting":
+        "committed samples == journal; acked epochs never regress",
+    "ckpt-durable": "newest acked checkpoint keeps live replicated copies",
+    "gang-terminal": "strict final: training terminal, borrows returned",
 }
 
 _NAME_RE = re.compile(r"\[inv:([a-z0-9-]+) @t=")
@@ -387,6 +402,13 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
         sv, sn = plane.check(strict=strict, now=now, grace=grace)
         violations.extend(sv)
         checks += sn
+
+    # training plane (when a train_diurnal campaign installed one)
+    tplane = getattr(cluster, "train_plane", None)
+    if tplane is not None and tplane.started:
+        tv, tn = tplane.check(strict=strict, now=now, grace=grace)
+        violations.extend(tv)
+        checks += tn
 
     # model-version plane (when a serve_rolling_update campaign
     # installed one)

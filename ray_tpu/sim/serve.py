@@ -129,6 +129,15 @@ class SimServePlane:
         self._admit = max(8, self.p.shard_queue // self.p.num_shards)
         self.digest: dict[str, int] = {nid: 0 for nid in base}
         self.loans: dict[str, dict] = {}    # nid -> {state, t0, t_drain}
+        # reverse direction (Aryl: train borrows serve capacity at the
+        # diurnal trough) — driven entirely by sim/train.py, so on
+        # campaigns without a train plane ``lent`` stays empty and every
+        # branch below is dead (replay hashes of serve-only runs are
+        # untouched)
+        self.lent: dict[str, dict] = {}     # nid -> {state, t0}
+        self.lends_total = 0
+        self.lends_returned = 0
+        self.lends_lost = 0
 
         # diurnal curve: one full cycle over the arrival window, scaled
         # to the base pool's steady-state capacity
@@ -177,7 +186,7 @@ class SimServePlane:
     @property
     def terminal(self) -> bool:
         return self.started and self.arrivals_done and \
-            self.outstanding == 0 and not self.loans
+            self.outstanding == 0 and not self.loans and not self.lent
 
     # -- arrivals ------------------------------------------------------------
     def _rate(self, t: float) -> float:
@@ -330,6 +339,16 @@ class SimServePlane:
             self.loans_lost += 1
             self.cluster.trace.rec(self.cluster.clock.monotonic(),
                                    "loan_lost", node=nid, phase="warming")
+        elif nid in self.lent:
+            # died while fully lent out (no replica on it): the lend
+            # record pops HERE and only here — booked exactly once even
+            # when the train plane also sees the kill
+            lend = self.lent.pop(nid)
+            self.reserved.discard(nid)
+            self.lends_lost += 1
+            self.cluster.trace.rec(self.cluster.clock.monotonic(),
+                                   "reverse_lend_lost", node=nid,
+                                   phase=lend["state"])
 
     def _replica_dead(self, nid: str) -> None:
         rep = self.replicas.pop(nid, None)
@@ -348,11 +367,19 @@ class SimServePlane:
         self.digest.pop(nid, None)
         self.reserved.discard(nid)
         loan = self.loans.pop(nid, None)
+        lend = self.lent.pop(nid, None)
         now = self.cluster.clock.monotonic()
         if loan is not None:
             self.loans_lost += 1    # popped record: booked exactly once
             self.cluster.trace.rec(now, "loan_lost", node=nid,
                                    phase=loan["state"],
+                                   redispatched=len(moved))
+        elif lend is not None:
+            # died while draining toward the train plane: same
+            # popped-record exactly-once contract as the forward loans
+            self.lends_lost += 1
+            self.cluster.trace.rec(now, "reverse_lend_lost", node=nid,
+                                   phase=lend["state"],
                                    redispatched=len(moved))
         else:
             self.cluster.trace.rec(now, "serve_replica_dead", node=nid,
@@ -379,6 +406,9 @@ class SimServePlane:
         for nid in [n for n in self.replicas if not self._node_alive(n)]:
             self._replica_dead(nid)
         for nid in [n for n in self.loans
+                    if n not in self.replicas and not self._node_alive(n)]:
+            self.on_node_killed(nid)
+        for nid in [n for n in self.lent
                     if n not in self.replicas and not self._node_alive(n)]:
             self.on_node_killed(nid)
 
@@ -491,6 +521,99 @@ class SimServePlane:
         for shard in self.shards:
             self._pump(shard)
 
+    # -- reverse loaning: the train plane borrows a serve replica node -------
+    # Same Aryl drain-reclaim semantics as the forward direction, with
+    # the roles swapped: serve is the lender, train the borrower.  The
+    # lender keeps the row in ``reserved`` for the whole lend (batch
+    # never places on it) and books a mid-lend death exactly once by
+    # popping the record.
+
+    def can_lend(self) -> bool:
+        """True when serve is at the trough: low backlog, no forward
+        loans outstanding, and at least two routable base replicas
+        would remain after lending one out."""
+        routable = [r for r in self.replicas.values()
+                    if r.alive and r.route_ok and not r.loaned]
+        return (self.started and not self.arrivals_done and
+                len(routable) > 2 and not self.loans and
+                self._backlog() < max(1, self.p.loan_backlog // 4))
+
+    def begin_lend(self) -> str | None:
+        """Stop routing to one idle base replica and start draining it
+        toward the train plane.  Returns its nid, or None."""
+        if not self.can_lend():
+            return None
+        now = self.cluster.clock.monotonic()
+        for nid in sorted(self.replicas, reverse=True):
+            rep = self.replicas[nid]
+            if not rep.alive or not rep.route_ok or rep.loaned or \
+                    nid in self.lent:
+                continue
+            rep.route_ok = False
+            self.lent[nid] = {"state": "draining", "t0": now}
+            self.lends_total += 1
+            self.cluster.trace.rec(now, "reverse_lend_started",
+                                   node=nid, backlog=self._backlog())
+            return nid
+        return None
+
+    def lend_ready(self, nid: str) -> bool:
+        """True once the draining replica emptied and the row was
+        handed over (replica popped; the train plane owns the node
+        until :meth:`end_lend` or death)."""
+        lend = self.lent.get(nid)
+        if lend is None:
+            return False
+        if lend["state"] == "lent":
+            return True
+        rep = self.replicas.get(nid)
+        if rep is None or not self._node_alive(nid):
+            return False
+        if rep.load() != 0:
+            return False
+        self.replicas.pop(nid)
+        self.digest.pop(nid, None)
+        for shard in self.shards:
+            shard.own.pop(nid, None)
+        lend["state"] = "lent"
+        self.cluster.trace.rec(self.cluster.clock.monotonic(),
+                               "reverse_lend_active", node=nid)
+        return True
+
+    def wants_back(self) -> bool:
+        """Serve pressure: when True the train plane must return every
+        borrowed replica at its next epoch boundary (drain-reclaim, the
+        mirror of batch_pressure in the forward direction)."""
+        return self.arrivals_done or \
+            self._backlog() >= max(1, self.p.loan_backlog // 2)
+
+    def end_lend(self, nid: str) -> None:
+        """Train hands the node back alive: the replica is re-created
+        and routing resumes.  A no-op if death already popped the
+        record (loss was booked there)."""
+        lend = self.lent.pop(nid, None)
+        if lend is None:
+            return
+        now = self.cluster.clock.monotonic()
+        if not self._node_alive(nid):
+            self.reserved.discard(nid)
+            self.lends_lost += 1
+            self.cluster.trace.rec(now, "reverse_lend_lost", node=nid,
+                                   phase=lend["state"])
+            return
+        self.lends_returned += 1
+        rep = self.replicas.get(nid)
+        if rep is not None:
+            rep.route_ok = True     # returned before the drain finished
+        else:
+            self.replicas[nid] = _Replica(nid, self.p.replica_cap)
+            self.digest[nid] = 0
+            if self.rollout is not None:
+                self.rollout.on_replica_added(nid)
+        self.cluster.trace.rec(now, "reverse_lend_returned", node=nid)
+        for shard in self.shards:
+            self._pump(shard)
+
     # -- aggregate trace window ----------------------------------------------
     def _window(self) -> None:
         if not self.cluster.running:
@@ -549,6 +672,15 @@ class SimServePlane:
                 f"active={len(self.loans)} + "
                 f"reclaimed={self.reclaims_total} + "
                 f"lost={self.loans_lost}"))
+        checks += 1
+        if self.lends_total != (len(self.lent) + self.lends_returned +
+                                self.lends_lost):
+            violations.append(fmt_violation(
+                "loan-conservation", now,
+                f"reverse lends_total={self.lends_total} != "
+                f"lent={len(self.lent)} + "
+                f"returned={self.lends_returned} + "
+                f"lost={self.lends_lost}"))
         drain_cap = self.cluster.params.drain_deadline_s + grace
         for nid, loan in self.loans.items():
             if loan["state"] != "draining":
@@ -567,11 +699,12 @@ class SimServePlane:
                     "serve-incomplete", now,
                     f"{self.outstanding} accepted requests never "
                     f"completed after quiesce"))
-            if self.loans:
+            if self.loans or self.lent:
                 violations.append(fmt_violation(
                     "loans-outstanding", now,
-                    f"{len(self.loans)} loans neither reclaimed nor "
-                    f"booked lost after quiesce"))
+                    f"{len(self.loans)} loans / {len(self.lent)} "
+                    f"reverse lends neither reclaimed nor booked lost "
+                    f"after quiesce"))
         return violations, checks
 
     # -- reporting -----------------------------------------------------------
@@ -604,6 +737,9 @@ class SimServePlane:
             "loans_total": self.loans_total,
             "reclaims_total": self.reclaims_total,
             "loans_lost": self.loans_lost,
+            "lends_total": self.lends_total,
+            "lends_returned": self.lends_returned,
+            "lends_lost": self.lends_lost,
             "mean_reclaim_s": round(
                 self._reclaim_sum / self.reclaims_total, 4)
             if self.reclaims_total else 0.0,

@@ -6,13 +6,19 @@ runs ``train_loop_per_worker`` on a gang of worker actors sized by
 report metrics/checkpoints via ``train.report``, and ``fit()`` returns a
 ``Result`` (SURVEY.md §1 layer 14, §2.4 DP row; mount empty).
 
-Two trainers, both real:
+Three trainers, all real:
 
 - **JaxTrainer** — the reference shape: N worker actors placed as a
   PACK gang, per-worker dataset shards, gradient allreduce over the
   ``ray_tpu.util.collective`` process group, and gang fault tolerance
   (``FailureConfig``): on a worker death the gang restarts and resumes
   from the checkpoint rank 0 persisted via ``train.report``.
+- **ElasticTrainer** — ``JaxTrainer`` with a cluster-durable run
+  identity: epoch/step journaled into the GCS-snapshotted KV (a
+  promoted standby inherits the run), resume weights broadcast-fed to
+  (re)joining workers, checkpoints replicated off the writing node,
+  drains/loan-reclaims handled as planned resizes, and SIGKILL
+  mid-allreduce recovered via typed ``GangMemberLost`` gang re-form.
 - **MeshTrainer** — the TPU-first shape: ONE process, N devices;
   the training step is compiled with ``shard_map`` over a
   ``jax.sharding.Mesh`` (batch sharded on the data axis, grads
@@ -21,10 +27,11 @@ Two trainers, both real:
 """
 
 from .checkpoint import Checkpoint
+from .elastic import ElasticTrainer
 from .mesh import MeshTrainer
 from .trainer import (FailureConfig, JaxTrainer, Result, ScalingConfig,
                       get_checkpoint, get_context, report)
 
-__all__ = ["Checkpoint", "FailureConfig", "JaxTrainer", "MeshTrainer",
-           "Result", "ScalingConfig", "get_checkpoint", "get_context",
-           "report"]
+__all__ = ["Checkpoint", "ElasticTrainer", "FailureConfig", "JaxTrainer",
+           "MeshTrainer", "Result", "ScalingConfig", "get_checkpoint",
+           "get_context", "report"]

@@ -59,13 +59,19 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, group: str,
                  shard, config: dict,
                  checkpoint_in: Checkpoint | None = None,
-                 persist_key: str | None = None):
+                 persist_key: str | None = None,
+                 collective_timeout_s: float | None = None):
         self._rank = rank
         self._world = world_size
         self._group = group
         self._shard = shard
         self._config = config
         self._persist_key = persist_key
+        # None = the global collective_timeout_s knob; the elastic
+        # trainer passes the tighter train_collective_timeout_s so a
+        # SIGKILLed peer surfaces as GangMemberLost within the gang's
+        # own budget instead of the cluster-wide default
+        self._coll_timeout = collective_timeout_s
         self.checkpoint_in = checkpoint_in
         self.reports: list[dict] = []
         self.checkpoint: Checkpoint | None = None
@@ -97,7 +103,8 @@ class TrainContext:
         flat = np.concatenate([np.asarray(x, dtype=np.float64).ravel()
                                for x in leaves]) if leaves else \
             np.zeros(0)
-        red = col.allreduce(flat, op="sum", group_name=self._group)
+        red = col.allreduce(flat, op="sum", group_name=self._group,
+                            timeout=self._coll_timeout)
         if op == "mean":
             red = red / self._world
         out, pos = [], 0
@@ -110,7 +117,7 @@ class TrainContext:
 
     def barrier(self) -> None:
         from ..util import collective as col
-        col.barrier(group_name=self._group)
+        col.barrier(group_name=self._group, timeout=self._coll_timeout)
 
     def report(self, metrics: dict,
                checkpoint: Checkpoint | None = None) -> None:

@@ -30,9 +30,44 @@ from functools import partial
 
 import numpy as np
 
+from ..common.config import get_config
 from ..runtime.serialization import deserialize, serialize
 
 _NAMESPACE = "collective"
+
+
+class GangMemberLost(TimeoutError):
+    """A collective round timed out with specific ranks missing — the
+    signature of a gang peer SIGKILLed between barrier and reduce.
+    Subclasses TimeoutError so pre-existing deadline handling still
+    catches it; carries the group/round/ranks so an elastic trainer can
+    convert it into a planned gang re-form instead of a failure."""
+
+    def __init__(self, group: str, seq: int, missing, timeout: float):
+        self.group = group
+        self.seq = int(seq)
+        self.missing_ranks = sorted(int(r) for r in missing)
+        self.timeout_s = float(timeout)
+        super().__init__(
+            f"collective {group} round {seq}: ranks "
+            f"{self.missing_ranks} missing after {timeout}s "
+            f"(gang member lost)")
+
+    def __reduce__(self):
+        # Exception's default reduce replays the formatted message into
+        # the 4-arg __init__; rebuild from the typed fields instead so
+        # the error survives the task-result pickle round-trip
+        return (GangMemberLost, (self.group, self.seq,
+                                 self.missing_ranks, self.timeout_s))
+
+
+def _resolve_timeout(timeout: float | None) -> float:
+    """Per-call override, else the W3-wired collective_timeout_s knob."""
+    if timeout is not None:
+        return float(timeout)
+    return float(get_config().collective_timeout_s)
+
+
 _REDUCERS = {
     "sum": lambda arrs: np.sum(arrs, axis=0),
     "prod": lambda arrs: np.prod(arrs, axis=0),
@@ -212,7 +247,10 @@ class _ProcessGroup:
         self._kv("put", self._key(seq, self.rank), payload)
 
     def _collect(self, seq: int, timeout: float) -> list[bytes]:
-        """All ranks' round-``seq`` payloads (poll until complete)."""
+        """All ranks' round-``seq`` payloads (poll until complete).
+        Ranks still missing at the deadline raise the typed
+        :class:`GangMemberLost` — without the bound, one SIGKILLed peer
+        parks every surviving rank here forever."""
         deadline = time.monotonic() + timeout
         out: list = [None] * self.world_size
         missing = set(range(self.world_size))
@@ -225,9 +263,7 @@ class _ProcessGroup:
             if not missing:
                 break
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"collective {self.name} round {seq}: ranks {missing} "
-                    f"missing after {timeout}s")
+                raise GangMemberLost(self.name, seq, missing, timeout)
             time.sleep(0.002)
         return out
 
@@ -239,7 +275,9 @@ class _ProcessGroup:
             for r in range(self.world_size):
                 self._kv("del", self._key(self.seq - 2, r))
 
-    def _round(self, payload: bytes, timeout: float) -> list[bytes]:
+    def _round(self, payload: bytes,
+               timeout: float | None) -> list[bytes]:
+        timeout = _resolve_timeout(timeout)
         self._sweep()
         seq = self.seq
         self.seq += 1
@@ -247,16 +285,19 @@ class _ProcessGroup:
         return self._collect(seq, timeout)
 
     # -- ops -----------------------------------------------------------------
-    def allreduce(self, array, op: str = "sum", timeout: float = 60.0):
+    def allreduce(self, array, op: str = "sum",
+                  timeout: float | None = None):
         arrs = [deserialize(p) for p in
                 self._round(serialize(np.asarray(array)), timeout)]
         return _REDUCERS[op](arrs)
 
-    def allgather(self, array, timeout: float = 60.0) -> list:
+    def allgather(self, array,
+                  timeout: float | None = None) -> list:
         return [deserialize(p) for p in
                 self._round(serialize(np.asarray(array)), timeout)]
 
-    def reducescatter(self, array, op: str = "sum", timeout: float = 60.0):
+    def reducescatter(self, array, op: str = "sum",
+                      timeout: float | None = None):
         """Each rank returns its chunk of the elementwise reduction
         (arrays split on axis 0 into world_size chunks)."""
         full = _REDUCERS[op]([deserialize(p) for p in
@@ -264,17 +305,20 @@ class _ProcessGroup:
                                           timeout)])
         return np.array_split(full, self.world_size)[self.rank]
 
-    def broadcast(self, array, src_rank: int = 0, timeout: float = 60.0):
+    def broadcast(self, array, src_rank: int = 0,
+                  timeout: float | None = None):
         payloads = self._round(
             serialize(np.asarray(array) if array is not None else None),
             timeout)
         return deserialize(payloads[src_rank])
 
-    def barrier(self, timeout: float = 60.0) -> None:
+    def barrier(self, timeout: float | None = None) -> None:
         self._round(serialize(None), timeout)
 
-    def send(self, array, dst_rank: int, timeout: float = 60.0) -> None:
+    def send(self, array, dst_rank: int,
+             timeout: float | None = None) -> None:
         key = f"{self.name}/{self.sid}/p2p/{self.rank}->{dst_rank}"
+        timeout = _resolve_timeout(timeout)
         deadline = time.monotonic() + timeout
         while self._kv("exists", key):          # previous message unread
             if time.monotonic() > deadline:
@@ -282,8 +326,9 @@ class _ProcessGroup:
             time.sleep(0.002)
         self._kv("put", key, serialize(np.asarray(array)))
 
-    def recv(self, src_rank: int, timeout: float = 60.0):
+    def recv(self, src_rank: int, timeout: float | None = None):
         key = f"{self.name}/{self.sid}/p2p/{src_rank}->{self.rank}"
+        timeout = _resolve_timeout(timeout)
         deadline = time.monotonic() + timeout
         while True:
             v = self._kv("get", key)
@@ -317,32 +362,39 @@ def _group(group_name: str) -> _ProcessGroup:
     return g
 
 
-def allreduce(array, op: str = "sum", group_name: str = "default"):
-    return _group(group_name).allreduce(array, op)
+def allreduce(array, op: str = "sum", group_name: str = "default",
+              timeout: float | None = None):
+    return _group(group_name).allreduce(array, op, timeout=timeout)
 
 
-def allgather(array, group_name: str = "default") -> list:
-    return _group(group_name).allgather(array)
+def allgather(array, group_name: str = "default",
+              timeout: float | None = None) -> list:
+    return _group(group_name).allgather(array, timeout=timeout)
 
 
-def reducescatter(array, op: str = "sum", group_name: str = "default"):
-    return _group(group_name).reducescatter(array, op)
+def reducescatter(array, op: str = "sum", group_name: str = "default",
+                  timeout: float | None = None):
+    return _group(group_name).reducescatter(array, op, timeout=timeout)
 
 
-def broadcast(array, src_rank: int = 0, group_name: str = "default"):
-    return _group(group_name).broadcast(array, src_rank)
+def broadcast(array, src_rank: int = 0, group_name: str = "default",
+              timeout: float | None = None):
+    return _group(group_name).broadcast(array, src_rank, timeout=timeout)
 
 
-def barrier(group_name: str = "default") -> None:
-    _group(group_name).barrier()
+def barrier(group_name: str = "default",
+            timeout: float | None = None) -> None:
+    _group(group_name).barrier(timeout=timeout)
 
 
-def send(array, dst_rank: int, group_name: str = "default") -> None:
-    _group(group_name).send(array, dst_rank)
+def send(array, dst_rank: int, group_name: str = "default",
+         timeout: float | None = None) -> None:
+    _group(group_name).send(array, dst_rank, timeout=timeout)
 
 
-def recv(src_rank: int, group_name: str = "default"):
-    return _group(group_name).recv(src_rank)
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float | None = None):
+    return _group(group_name).recv(src_rank, timeout=timeout)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

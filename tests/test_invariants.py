@@ -85,6 +85,20 @@ def _healthy_cluster():
                        _ro("r3", "v2", "v3", "FLIPPING", None)]
     rplane.active = rplane.rollouts[1]
 
+    # elastic training plane: mid-run but quiet — journal, counters and
+    # the acked checkpoint's replication all agree
+    from ray_tpu.sim.train import SimTrainPlane
+    tplane = SimTrainPlane(cluster, duration=50.0, serve=plane)
+    tplane.started = True
+    tplane.state = "forming"
+    tplane.acked_epoch = tplane._hwm_epoch = 2
+    tplane.epochs_committed = 2
+    tplane.samples_committed = 256
+    tplane.ckpts[2] = {"copies": {"n00001", "n00002"}, "t_write": 5.0,
+                       "t_degraded": None, "acked": True, "repl": 0}
+    cluster.persist["train"] = {"epoch": 2, "samples": 256, "gang": 2}
+    cluster.train_plane = tplane
+
     # legal revocation history: strictly increasing epochs
     cluster.revocation_log["n00003"] = [(1, 5.0), (2, 6.0)]
     cluster.broadcast_waves = [
@@ -237,6 +251,21 @@ def _old_version_retained(c, acked):
     c.rollout_plane.active["old_retained"] = False
 
 
+def _goodput_accounting(c, acked):
+    # plane claims more committed samples than the durable journal
+    c.train_plane.samples_committed += 64
+
+
+def _ckpt_durable(c, acked):
+    # every copy of the acked checkpoint sits on a dead/unknown node
+    c.train_plane.ckpts[2]["copies"] = {"n-gone"}
+
+
+def _gang_terminal(c, acked):
+    # strict final with the run still mid-epoch (state != done)
+    pass
+
+
 def _finish_waves(c):
     for w in c.broadcast_waves:
         if w.t_done is None:
@@ -249,6 +278,14 @@ def _finish_waves(c):
                 ro["phase"], ro["t_done"] = "SEALED", _now(c)
         rp.active = None
         rp.queued.clear()
+    # quiesce twin for the train plane: the run wraps up cleanly
+    tp = getattr(c, "train_plane", None)
+    if tp is not None and tp.state != "done":
+        tp.state = "done"
+        tp.gang = []
+        tp.reserved.clear()
+        tp.borrowed = []
+        tp._pending_borrows = []
 
 
 CORRUPTIONS = {
@@ -275,6 +312,9 @@ CORRUPTIONS = {
     "version-mixed-session": (_version_mixed_session, False),
     "rollout-terminal": (_rollout_terminal, True),
     "old-version-retained": (_old_version_retained, False),
+    "goodput-accounting": (_goodput_accounting, False),
+    "ckpt-durable": (_ckpt_durable, False),
+    "gang-terminal": (_gang_terminal, True),
 }
 
 
@@ -291,7 +331,8 @@ def test_invariant_fires_on_corrupted_state(name):
     try:
         corrupt(cluster, acked)
         if strict and name not in ("bcast-wave-terminal",
-                                   "rollout-terminal"):
+                                   "rollout-terminal",
+                                   "gang-terminal"):
             _finish_waves(cluster)
         v, checks = check_invariants(cluster, acked, strict=strict)
         assert name in violation_names(v), (name, v)

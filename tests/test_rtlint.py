@@ -909,3 +909,27 @@ class TestW6:
             files=[os.path.join(REPO_ROOT, m) for m in new_modules])
         assert [f for f in findings if f.rule != "E0"] == [], \
             "versioning plane must stay clock- and sync-free"
+
+    def test_train_modules_in_w5_w6_scope_with_zero_baseline(self):
+        """The r19 elastic training plane — the live controller and its
+        simulator twin — is inside W5's clock-seam scope (restart and
+        drain timings must go through the seam so goodput accounting
+        replays) AND W6's device-sync scope, and contributes zero
+        grandfathered baseline entries."""
+        from tools.rtlint import rules_device, rules_time
+        new_modules = ("ray_tpu/train/elastic.py", "ray_tpu/sim/train.py")
+        for mod in new_modules:
+            assert os.path.exists(os.path.join(REPO_ROOT, mod))
+            assert any(mod.startswith(sc) for sc in rules_time._SCOPES)
+            assert mod in rules_device._EXTRA_FILES
+        accepted = baseline_mod.load(os.path.join(
+            REPO_ROOT, "tools", "rtlint", "baseline.json"))
+        for key in accepted:
+            assert not any(m in key for m in new_modules), \
+                f"grandfathered finding in a new module: {key}"
+        # live, not vacuous: both pass W5+W6 as they stand
+        findings = analyzer.run_analysis(
+            REPO_ROOT, package="ray_tpu", rules=("W5", "W6"),
+            files=[os.path.join(REPO_ROOT, m) for m in new_modules])
+        assert [f for f in findings if f.rule != "E0"] == [], \
+            "elastic training plane must stay clock- and sync-free"

@@ -277,8 +277,12 @@ class TestKvAccounting:
 
         actor_api.ActorMethod = Exploding
         try:
+            # the fast path hands back a promise ref now, so the submit
+            # failure arrives poisoned at get() rather than raising at
+            # the call site — the KV rollback is what's under test
+            ref = handle.remote()
             with pytest.raises(RuntimeError, match="injected"):
-                handle.remote()
+                ray_tpu.get(ref, timeout=30)
         finally:
             actor_api.ActorMethod = real
         deadline = time.monotonic() + 5

@@ -355,6 +355,151 @@ class TestCapacityLoaning:
             if cluster.crm.row_of(nid) is not None:
                 cluster.remove_node(nid)
 
+    def _lendable_pool(self, cluster):
+        """Two batch nodes exposing a ``lendable`` resource the head
+        lacks, so a 2-replica deployment pinned to it lands one replica
+        per node — the released (newest) replica's node is then a
+        removable non-head row."""
+        nids = [cluster.add_node(
+            resources={"CPU": 2, "memory": 2, "lendable": 1},
+            num_workers=2) for _ in range(2)]
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=1,
+                          ray_actor_options={
+                              "resources": {"lendable": 1}})
+        class Slow:
+            def __call__(self, x):
+                time.sleep(0.4)
+                return x + 1
+
+        handle = serve.run(Slow.bind())
+        # warm up: creates the driver-side RouterGroup the manager
+        # reads, and leaves the deployment QUIET (queued=inflight=0)
+        assert ray_tpu.get(handle.remote(0), timeout=60) == 1
+        return nids, handle
+
+    def _teardown_lend_pool(self, cluster, nids):
+        serve.delete()
+        for nid in nids:
+            if cluster.crm.row_of(nid) is not None:
+                cluster.remove_node(nid)
+        # book any leftover lend records against the removed nodes NOW
+        # so they never surface as phantom losses in the next test
+        for _ in range(3):
+            cluster.loans.tick()
+            time.sleep(0.05)
+        # a lend under a long serve_loan_cooldown_s leaves the manager's
+        # cooldown clock armed past this test — disarm it
+        cluster.loans._cooldown_until = 0.0
+
+    def test_reverse_lend_starts_drains_and_returns_on_pressure(self):
+        """The reverse direction: unmet batch demand with no idle batch
+        row borrows a quiet deployment's newest replica (drain -> lent);
+        serve backlog pressure ends the lend and a fresh replica makes
+        serve whole."""
+        cluster = _cluster()
+        base = cluster.loans.stats()
+        nids, handle = self._lendable_pool(cluster)
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                cluster.loans.tick(unmet=1)
+                if cluster.loans.stats()["reverse_lends_active"]:
+                    break
+                time.sleep(0.1)
+            st = cluster.loans.stats()
+            assert st["reverse_lends_total"] == \
+                base["reverse_lends_total"] + 1
+            assert st["reverse_lends_active"] == 1
+            assert st["loans_active"] == 0      # never both directions
+            _wait_replicas(1)                   # replica out of routing
+            rl = cluster.loans._rloans[0]
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                cluster.loans.tick()
+                if rl.state == "lent":
+                    break
+                time.sleep(0.1)
+            assert rl.state == "lent", rl.state
+
+            # serve pressure: backlog on the one remaining replica ends
+            # the lend and restores a replacement replica
+            refs = [handle.remote(i) for i in range(6)]
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                cluster.loans.tick()
+                if cluster.loans.stats()["reverse_lends_active"] == 0:
+                    break
+                time.sleep(0.1)
+            st = cluster.loans.stats()
+            assert st["reverse_lends_returned"] == \
+                base["reverse_lends_returned"] + 1
+            assert st["reverse_lends_active"] == 0
+            assert st["reverse_lends_lost"] == base["reverse_lends_lost"]
+            # an inline dispatch racing the stale routing view may have
+            # hit the released replica; queued requests failed over —
+            # count the survivors, and NEW traffic must flow
+            ok = 0
+            for r in refs:
+                try:
+                    ray_tpu.get(r, timeout=60)
+                    ok += 1
+                except Exception:   # noqa: BLE001 — stale-view race
+                    pass
+            assert ok >= len(refs) - 1, f"only {ok}/{len(refs)} served"
+            assert ray_tpu.get(handle.remote(50), timeout=60) == 51
+            _wait_replicas(2)                   # serve made whole
+        finally:
+            self._teardown_lend_pool(cluster, nids)
+
+    def test_node_death_mid_reverse_lend_books_loss_once(self):
+        """Chaos twin in the NEW direction: the lent node dies while
+        batch holds it.  The loss is booked exactly once (popping the
+        record IS the bookkeeping — extra beats never double-count) and
+        serve keeps serving on its surviving replica."""
+        # long cooldown: exactly ONE lend this test, no re-lend racing
+        # the death booking
+        Config.reset({"serve_loan_backlog": 2,
+                      "serve_loan_cooldown_s": 60.0,
+                      "serve_loan_reclaim_idle_s": 60.0,
+                      "serve_loan_drain_timeout_s": 30.0})
+        cluster = _cluster()
+        base = cluster.loans.stats()
+        nids, handle = self._lendable_pool(cluster)
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                cluster.loans.tick(unmet=1)
+                if cluster.loans.stats()["reverse_lends_active"]:
+                    break
+                time.sleep(0.1)
+            assert cluster.loans.stats()["reverse_lends_active"] == 1
+            rl = cluster.loans._rloans[0]
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                cluster.loans.tick()
+                if rl.state == "lent":
+                    break
+                time.sleep(0.1)
+            assert rl.state == "lent", rl.state
+
+            # the lent node dies the way the health manager removes it
+            cluster.remove_node(rl.node_id)
+            for _ in range(3):      # extra beats: booked exactly once
+                cluster.loans.tick()
+                time.sleep(0.05)
+            st = cluster.loans.stats()
+            assert st["reverse_lends_lost"] == \
+                base["reverse_lends_lost"] + 1, st
+            assert st["reverse_lends_active"] == 0
+            # the dying lend never returned — the death path booked it
+            assert st["reverse_lends_returned"] == \
+                base["reverse_lends_returned"]
+            # serve still serves on the surviving replica
+            assert ray_tpu.get(handle.remote(100), timeout=60) == 101
+        finally:
+            self._teardown_lend_pool(cluster, nids)
+
     def test_sigkill_loaned_node_mid_reclaim_books_loss_once(self):
         """Chaos: the loaned node dies while its reclaim drain is in
         flight.  The drain must converge (by death), the router must

@@ -92,6 +92,28 @@ def test_serve_diurnal_campaign_replays_bit_for_bit():
     assert s == r2.stats["serve"]
 
 
+def test_train_diurnal_campaign_replays_bit_for_bit():
+    """The training plane (gang epochs, journal acks, checkpoint
+    replication, pool borrows against the diurnal serve load) draws
+    from the same Philox stream discipline as everything else: same
+    seed, same trace hash, same epoch ledger."""
+    kw = dict(seed=7, campaign="train_diurnal", faults=50,
+              duration=400.0)
+    r1 = run_campaign(48, **kw)
+    r2 = run_campaign(48, **kw)
+    assert r1.ok, r1.violations
+    assert r1.trace_hash == r2.trace_hash
+    t = r1.stats["train"]
+    assert t == r2.stats["train"]
+    # the run finished its day: terminal state, real progress, and the
+    # fault schedule actually bit (gang losses recovered, not avoided)
+    assert t["state"] == "done"
+    assert t["epochs_committed"] > 0 and t["samples_committed"] > 0
+    assert t["acked_epoch"] == t["epochs_committed"]
+    assert t["gang_losses"] > 0
+    assert t["borrows_total"] >= t["borrows_returned"] >= 0
+
+
 @pytest.mark.parametrize("campaign", CAMPAIGNS)
 def test_every_campaign_archetype_green(campaign):
     r = run_campaign(48, seed=11, campaign=campaign, faults=8,
@@ -121,7 +143,7 @@ def test_trace_artifact_format(tmp_path):
     for k, v in doc["knobs"].items():
         assert k.startswith(("chaos_", "lease_", "serve_", "sim_",
                              "standby_", "rollout_", "version_",
-                             "rpc_breaker_",
+                             "train_", "collective_", "rpc_breaker_",
                              "rtlint_runtime_lock_order"))
         assert cfg[k] == v
     assert "sim_heartbeat_period_s" in doc["knobs"]

@@ -42,7 +42,8 @@ _SCOPES = ("ray_tpu/ops/", "ray_tpu/scheduling/", "ray_tpu/leasing/",
 # the sim search loop (hunt/minimize) must never touch a device —
 # thousands of probe runs per hunt would serialize on any sync point
 _EXTRA_FILES = ("ray_tpu/runtime/raylet.py", "ray_tpu/sim/hunt.py",
-                "ray_tpu/sim/minimize.py")
+                "ray_tpu/sim/minimize.py",
+                "ray_tpu/train/elastic.py", "ray_tpu/sim/train.py")
 _NP_COERCIONS = ("asarray", "array")
 
 

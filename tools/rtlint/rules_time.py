@@ -45,7 +45,11 @@ _SCOPES = ("ray_tpu/runtime/", "ray_tpu/rpc/", "ray_tpu/broadcast/",
            # the hunt must be a pure function of its Philox seed:
            # wall-clock reads would make search order (and therefore
            # findings) machine-dependent — callers time it themselves
-           "ray_tpu/sim/hunt.py", "ray_tpu/sim/minimize.py")
+           "ray_tpu/sim/hunt.py", "ray_tpu/sim/minimize.py",
+           # the elastic training plane schedules restarts and drains
+           # off the shared clock (live) / the virtual clock (sim) —
+           # raw wall-clock reads would skew goodput accounting
+           "ray_tpu/train/elastic.py", "ray_tpu/sim/train.py")
 _TRANSPORT_SCOPE = ("ray_tpu/runtime/", "ray_tpu/broadcast/",
                     "ray_tpu/leasing/")
 _EXEMPT = ("ray_tpu/common/clock.py", "ray_tpu/rpc/transport.py")
