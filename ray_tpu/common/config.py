@@ -480,6 +480,16 @@ _CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
         "static W2 rule — catches cross-object nesting static "
         "analysis cannot see.  Test/debug only: adds per-acquire "
         "bookkeeping to every lock constructed while enabled."),
+    "rtlint_runtime_locksets": (
+        bool, False,
+        "Instrument @locksets.track classes (common/locksets.py) to "
+        "sample the per-thread held-lock set at every tracked "
+        "attribute write, Eraser-style; the chaos/drain suites assert "
+        "no attribute is written from two threads with an empty "
+        "lockset intersection.  Dynamic complement of rtlint's static "
+        "W7 rule — catches sharing through callbacks and fixtures "
+        "static analysis cannot see.  Test/debug only: adds a sample "
+        "per tracked write while enabled."),
     # -- in-process simulator (ray_tpu/sim/) --------------------------------
     "sim_heartbeat_period_s": (
         float, 5.0,

@@ -72,7 +72,9 @@ class JobManager:
     def attach_kv(self, kv) -> None:
         """Persist job rows into the GCS KV so they ride its snapshots
         (reference: job table lives in the GCS — SURVEY.md §1 layer 3)."""
-        self._kv = kv
+        # Single publish during head bootstrap, before any job thread
+        # exists; _persist reading the slot unlocked is then safe.
+        self._kv = kv  # rtlint: disable=W7
 
     def _persist(self, info: JobInfo) -> None:
         if self._kv is None:
@@ -136,10 +138,11 @@ class JobManager:
         if not cmd:
             raise ValueError("empty job entrypoint")
         if job_id is None:
+            from ..common.ids import fast_random_bytes
             with self._lock:
                 self._counter += 1
-                job_id = \
-                    f"raysubmit_{self._counter:06d}_{os.urandom(4).hex()}"
+                suffix = fast_random_bytes(4).hex()
+                job_id = f"raysubmit_{self._counter:06d}_{suffix}"
         log_path = os.path.join(self._log_dir, f"job-{job_id}.log")
         info = JobInfo(job_id, entrypoint, metadata or {}, log_path,
                        runtime_env=runtime_env)
@@ -190,7 +193,8 @@ class JobManager:
         self._persist(info)
 
     def status(self, job_id: str) -> dict:
-        info = self._jobs.get(job_id)
+        with self._lock:
+            info = self._jobs.get(job_id)
         if info is None:
             raise KeyError(f"no job {job_id!r}")
         return info.to_dict()
@@ -200,7 +204,8 @@ class JobManager:
             return [j.to_dict() for j in self._jobs.values()]
 
     def logs(self, job_id: str) -> str:
-        info = self._jobs.get(job_id)
+        with self._lock:
+            info = self._jobs.get(job_id)
         if info is None:
             raise KeyError(f"no job {job_id!r}")
         try:
@@ -210,7 +215,8 @@ class JobManager:
             return ""
 
     def stop(self, job_id: str) -> bool:
-        info = self._jobs.get(job_id)
+        with self._lock:
+            info = self._jobs.get(job_id)
         if info is None:
             raise KeyError(f"no job {job_id!r}")
         if info.proc is not None and info.proc.poll() is None:

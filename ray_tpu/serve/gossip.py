@@ -38,6 +38,7 @@ from __future__ import annotations
 import threading
 
 from ..common import clock as _clk
+from ..common import locksets
 
 __all__ = ["LoadBoard", "board", "fold_all"]
 
@@ -57,6 +58,7 @@ class _Folded:
         self.versions = versions or {}  # replica_key -> model version
 
 
+@locksets.track("folds", "evicted_replicas")
 class LoadBoard:
     """Process-local gossip board, one entry per deployment (keyed by
     the controller's KV base).  A leaf lock: callers snapshot shard

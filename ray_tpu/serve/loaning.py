@@ -47,6 +47,7 @@ import threading
 import numpy as np
 
 from ..common import clock as _clk
+from ..common import locksets
 from ..common.config import get_config
 from ..common.resources import ResourceRequest, to_cu
 
@@ -94,6 +95,9 @@ class _ReverseLend:
         self.drain_deadline = 0.0
 
 
+@locksets.track("loans_total", "reclaims_total", "loans_lost",
+                "reverse_lends_total", "reverse_lends_returned",
+                "reverse_lends_lost", "last_reclaim_latency_s")
 class CapacityLoanManager:
     """Tracks LOANED rows atop the CRM and drives the loan/reclaim
     state machine.  Driver-side: it reads the driver-local router
@@ -470,6 +474,10 @@ class CapacityLoanManager:
                     for loan in self._loans]
 
     def stats(self) -> dict:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
         return {"loans_total": self.loans_total,
                 "reclaims_total": self.reclaims_total,
                 "loans_lost": self.loans_lost,

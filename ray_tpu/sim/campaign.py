@@ -478,7 +478,8 @@ def run_campaign(num_nodes: int, seed: int = 0, campaign: str = "mixed",
 # function of the artifact, never of the ambient env
 _KNOB_PREFIXES = ("chaos_", "lease_", "serve_", "sim_", "standby_",
                   "rollout_", "version_", "train_", "collective_",
-                  "rpc_breaker_", "rtlint_runtime_lock_order")
+                  "rpc_breaker_", "rtlint_runtime_lock_order",
+                  "rtlint_runtime_locksets")
 
 
 def knob_snapshot() -> dict:

@@ -450,4 +450,14 @@ def check_invariants(cluster, acked_jobs, strict: bool = False
         except AssertionError as e:
             v("lock-order-cycle", str(e))
 
+    # empty-lockset shared write (when the Eraser recorder is armed —
+    # see rtlint_runtime_locksets)
+    from ..common import locksets
+    if locksets.installed():
+        checks += 1
+        try:
+            locksets.assert_no_races()
+        except AssertionError as e:
+            v("lockset-race", str(e))
+
     return violations, checks

@@ -217,8 +217,8 @@ class _ProcessGroup:
         re-ack — half of a dead group — raises TimeoutError instead of
         producing a silently wrong reduction."""
         import hashlib
-        import os
-        nonce = os.urandom(8).hex().encode()
+        from ..common.ids import fast_random_bytes
+        nonce = fast_random_bytes(8).hex().encode()
         self._kv("put", f"{self.name}/join/{self.rank}", nonce)
         deadline = time.monotonic() + timeout
         while True:
@@ -255,7 +255,9 @@ class _ProcessGroup:
         out: list = [None] * self.world_size
         missing = set(range(self.world_size))
         while missing:
-            for r in list(missing):
+            # sorted: rank polling order drives per-link chaos draws,
+            # so it must not depend on set memory layout
+            for r in sorted(missing):
                 v = self._kv("get", self._key(seq, r))
                 if v is not None:
                     out[r] = v

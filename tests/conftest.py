@@ -79,6 +79,26 @@ def _runtime_lock_order():
             lockorder.reset()
 
 
+@pytest.fixture(autouse=True)
+def _runtime_locksets():
+    """rtlint's OTHER dynamic mode: when the ``rtlint_runtime_locksets``
+    knob is on (RT_RTLINT_RUNTIME_LOCKSETS=1), instances of
+    @locksets.track classes constructed during a test sample the
+    per-thread held-lock set at every tracked attribute write; after
+    the test no attribute may have been written from two threads with
+    an empty lockset intersection (Eraser).  Asserting per test (then
+    resetting) attributes a race to the test whose workload produced
+    it.  Off by default: zero overhead."""
+    from ray_tpu.common import locksets
+    installed = locksets.maybe_install_from_config()
+    yield
+    if installed:
+        try:
+            locksets.assert_no_races()
+        finally:
+            locksets.reset()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

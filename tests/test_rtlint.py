@@ -933,3 +933,576 @@ class TestW6:
             files=[os.path.join(REPO_ROOT, m) for m in new_modules])
         assert [f for f in findings if f.rule != "E0"] == [], \
             "elastic training plane must stay clock- and sync-free"
+
+
+# -- W7: lockset race detection ----------------------------------------------
+
+class TestW7:
+    def test_fires_with_both_witness_paths(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def bump(self):
+                    self.count += 1
+
+                def read(self):
+                    return self.count
+            ''', rules=("W7",))
+        assert len(fs) == 1, details(fs)
+        f = fs[0]
+        assert f.detail == "race:Svc.count"
+        # both witness access paths in the message
+        assert "bump" in f.message and "read" in f.message
+        assert "write at" in f.message
+        assert "holding no lock" in f.message
+
+    def test_quiet_when_guarded_by_one_lock(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def read(self):
+                    with self._lock:
+                        return self.count
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_fires_on_thread_target_vs_api(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.beats = 0
+                    self._t = threading.Thread(target=self._loop,
+                                               daemon=True)
+
+                def _loop(self):
+                    self.beats += 1
+
+                def stats(self):
+                    with self._lock:
+                        return self.beats
+            ''', rules=("W7",))
+        assert len(fs) == 1, details(fs)
+        assert "thread target" in fs[0].message
+
+    def test_fires_on_timer_callback_context(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Beat:
+                def __init__(self, clk):
+                    self._lock = threading.Lock()
+                    self.ticks = 0
+                    clk.call_later(1.0, self._tick)
+
+                def _tick(self):
+                    self.ticks += 1
+
+                def read(self):
+                    with self._lock:
+                        return self.ticks
+            ''', rules=("W7",))
+        assert len(fs) == 1, details(fs)
+        assert "timer callback" in fs[0].message
+
+    def test_fires_on_escaped_handler_context(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Handlers:
+                def __init__(self, server):
+                    self._lock = threading.Lock()
+                    self.hits = 0
+                    server.register({"hit": self._on_hit})
+
+                def _on_hit(self):
+                    self.hits += 1
+
+                def read(self):
+                    with self._lock:
+                        return self.hits
+            ''', rules=("W7",))
+        assert len(fs) == 1, details(fs)
+        assert "registered callback" in fs[0].message
+
+    def test_locked_helper_propagation_is_quiet(self, tmp_path):
+        """One-level interprocedural: a write inside a private helper
+        called with the lock held inherits the caller's lockset."""
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def _bump_locked(self):
+                    self.count += 1
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def read(self):
+                    with self._lock:
+                        return self.count
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_nonblocking_acquire_try_finally_is_locked(self, tmp_path):
+        """The tick() idiom: acquire(blocking=False) + try/finally is
+        a critical section even without a with-block."""
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Ticker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.ticks = 0
+
+                def tick(self):
+                    if not self._lock.acquire(blocking=False):
+                        return
+                    try:
+                        self.ticks += 1
+                    finally:
+                        self._lock.release()
+
+                def read(self):
+                    with self._lock:
+                        return self.ticks
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_condition_aliasing_same_lock_is_quiet(self, tmp_path):
+        """Condition(self._lock) IS self._lock for lockset purposes."""
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self.depth = 0
+
+                def put(self):
+                    with self._cv:
+                        self.depth += 1
+                        self._cv.notify()
+
+                def drain(self):
+                    with self._lock:
+                        self.depth = 0
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_assign_once_immutable_publish_is_quiet(self, tmp_path):
+        """__init__-only writes are the immutable-publish escape."""
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Frozen:
+                def __init__(self, rows):
+                    self._lock = threading.Lock()
+                    self.rows = tuple(rows)
+
+                def read(self):
+                    return self.rows
+
+                def also_read(self):
+                    return len(self.rows)
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_lockless_class_out_of_scope(self, tmp_path):
+        """W7 only audits classes that own at least one lock (the W1
+        scope rule): plain single-threaded state holders stay quiet."""
+        fs = lint_snippet(tmp_path, '''
+            class Bag:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+
+                def read(self):
+                    return self.n
+            ''', rules=("W7",))
+        assert fs == []
+
+    def test_inline_suppression(self, tmp_path):
+        fs = lint_snippet(tmp_path, '''
+            import threading
+
+            class Gauge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.hits = 0
+
+                def bump(self):
+                    # deliberately racy monotonic gauge
+                    self.hits += 1  # rtlint: disable=W7
+
+                def read(self):
+                    with self._lock:
+                        return self.hits
+            ''', rules=("W7",))
+        assert fs == []
+
+
+# -- W8: replay-determinism discipline ----------------------------------------
+
+class TestW8:
+    def _lint(self, tmp_path, relpath, source):
+        """W8 scopes by real package paths (sim/, chaos, the routed
+        entropy seams), so fixtures mirror that tree."""
+        target = tmp_path / relpath
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(source))
+        findings = analyzer.run_analysis(
+            str(tmp_path), package="ray_tpu", rules=("W8",),
+            files=[str(target)])
+        return [f for f in findings if f.rule != "E0"]
+
+    def test_fires_on_global_stream_draws(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            import os
+            import random
+            import uuid
+            import numpy as np
+            from random import randint
+
+            def draws():
+                a = random.random()
+                b = np.random.rand(3)
+                c = uuid.uuid4()
+                d = os.urandom(8)
+                e = randint(0, 9)
+                return a, b, c, d, e
+            ''')
+        ds = sorted(f.detail for f in fs)
+        assert len(fs) == 5, ds
+        assert any(d.startswith("entropy:random.random@") for d in ds)
+        assert any(d.startswith("entropy:np.random.rand@") for d in ds)
+        assert any(d.startswith("entropy:uuid.uuid4@") for d in ds)
+        assert any(d.startswith("entropy:os.urandom@") for d in ds)
+        assert any(d.startswith("entropy:random.randint@") for d in ds)
+
+    def test_quiet_on_injected_seeded_streams(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            import random
+            import numpy as np
+
+            def sanctioned(seed):
+                rng = random.Random(seed)
+                gen = np.random.Generator(np.random.Philox(key=[seed]))
+                return rng.random(), gen.random(4), rng.randint(0, 9)
+            ''')
+        assert fs == []
+
+    def test_fires_on_id_and_hash(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            def keys(obj, name):
+                return id(obj), hash(name)
+            ''')
+        ds = sorted(f.detail for f in fs)
+        assert len(fs) == 2, ds
+        assert any(d.startswith("identity:id@") for d in ds)
+        assert any(d.startswith("identity:hash@") for d in ds)
+
+    def test_fires_on_set_iteration_feeding_consumers(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            PENDING = {"a", "b", "c"}
+
+            def schedule(emit):
+                for name in PENDING:
+                    emit(name)
+
+            def through_list(emit):
+                for name in list(PENDING):
+                    emit(name)
+
+            def comprehended():
+                return [n.upper() for n in PENDING]
+            ''')
+        assert len(fs) == 3, sorted(f.detail for f in fs)
+        assert all(d.startswith("setiter:") for _, d in details(fs))
+
+    def test_sorted_and_setcomp_and_dict_are_quiet(self, tmp_path):
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            PENDING = {"a", "b", "c"}
+            TABLE = {"x": 1}
+
+            def sorted_loop(emit):
+                for name in sorted(PENDING):
+                    emit(name)
+
+            def sorted_genexp():
+                return sorted(n.upper() for n in PENDING)
+
+            def to_set():
+                return {n.upper() for n in PENDING}
+
+            def dict_loop(emit):
+                # plain dicts are insertion-ordered: legal
+                for k, v in TABLE.items():
+                    emit(k, v)
+            ''')
+        assert fs == [], details(fs)
+
+    def test_out_of_scope_and_suppressed_sites_quiet(self, tmp_path):
+        # outside sim scope: free to draw
+        fs = self._lint(tmp_path, "ray_tpu/serve/mod.py", '''
+            import random
+
+            def jitter():
+                return random.random()
+            ''')
+        assert fs == []
+        # deliberate process-local identity, visibly annotated
+        fs = self._lint(tmp_path, "ray_tpu/sim/mod.py", '''
+            def pace_key(sock):
+                return id(sock)  # rtlint: disable=W8
+            ''')
+        assert fs == []
+
+
+# -- W7/W8 over the live package ---------------------------------------------
+
+class TestW7W8LivePackage:
+    BASELINE = os.path.join(REPO_ROOT, "tools", "rtlint",
+                            "baseline.json")
+
+    def test_w7_green_and_satellite_files_unbaselined(self):
+        """The race fixes are real fixes, not baseline entries: the
+        serve/loaning/metrics counters and the other files this PR
+        repaired contribute ZERO grandfathered W7 findings."""
+        new, based, stale, _ = analyzer.check(
+            REPO_ROOT, "ray_tpu", rules=("W7",),
+            baseline_path=self.BASELINE)
+        assert new == [], [f.format_text() for f in new]
+        assert based, "W7 found nothing on the live package — broken?"
+        fixed = ("ray_tpu/serve/loaning.py", "ray_tpu/serve/gossip.py",
+                 "ray_tpu/serve/router.py",
+                 "ray_tpu/scheduling/cluster_resources.py",
+                 "ray_tpu/runtime/runtime_env.py",
+                 "ray_tpu/runtime/job_manager.py")
+        for f in based:
+            assert f.path not in fixed, \
+                f"grandfathered W7 in a repaired file: {f.fingerprint}"
+
+    def test_w8_green_with_zero_baseline(self):
+        """Every W8 finding was FIXED (entropy routed through seams,
+        set iterations sorted) or inline-justified — none
+        grandfathered."""
+        new, based, stale, _ = analyzer.check(
+            REPO_ROOT, "ray_tpu", rules=("W8",),
+            baseline_path=self.BASELINE)
+        assert new == [], [f.format_text() for f in new]
+        assert based == [], [f.fingerprint for f in based]
+        accepted = baseline_mod.load(self.BASELINE)
+        assert not any(k.startswith("W8:") for k in accepted)
+
+
+# -- runtime lockset recorder -------------------------------------------------
+
+class TestRuntimeLocksets:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from ray_tpu.common import locksets
+        was = locksets.installed()
+        yield
+        if not was:
+            locksets.uninstall()
+        locksets.reset()
+
+    def test_config_gate(self):
+        from ray_tpu.common import locksets
+        from ray_tpu.common.config import Config
+        if locksets.installed():
+            pytest.skip("suite already runs with the recorder installed")
+        Config.reset()
+        assert locksets.maybe_install_from_config() is False
+        Config.reset(system_config={"rtlint_runtime_locksets": True})
+        assert locksets.maybe_install_from_config() is True
+        assert locksets.installed()
+
+    def test_seeded_race_detected(self):
+        from ray_tpu.common import locksets
+
+        @locksets.track("x", "y")
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+                self.y = 0
+
+            def locked_bump(self):
+                with self._lock:
+                    self.x += 1
+                    self.y += 1
+
+            def racy_bump(self):
+                self.x += 1     # the seeded race
+
+        locksets.install()
+        locksets.reset()
+        b = Box()
+        t1 = threading.Thread(
+            target=lambda: [b.locked_bump() for _ in range(100)])
+        t2 = threading.Thread(
+            target=lambda: [b.racy_bump() for _ in range(100)])
+        t1.start(); t2.start(); t1.join(5.0); t2.join(5.0)
+        v = locksets.violations()
+        assert any("Box.x" in s for s in v), v
+        assert not any("Box.y" in s for s in v), v
+        with pytest.raises(AssertionError, match="empty-lockset"):
+            locksets.assert_no_races()
+
+    def test_clean_class_stays_quiet(self):
+        from ray_tpu.common import locksets
+
+        @locksets.track("n")
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+        locksets.install()
+        locksets.reset()
+        c = Clean()
+        ts = [threading.Thread(
+            target=lambda: [c.bump() for _ in range(100)])
+            for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join(5.0) for t in ts]
+        assert c.n == 400
+        assert locksets.violations() == []
+        locksets.assert_no_races()
+
+    def test_init_writes_are_immutable_publish(self):
+        """Constructor writes never sample: assign-once publish stays
+        quiet even when another thread writes later WITH the lock."""
+        from ray_tpu.common import locksets
+
+        @locksets.track("rows")
+        class Pub:
+            def __init__(self, rows):
+                self._lock = threading.Lock()
+                self.rows = tuple(rows)     # unlocked: __init__ only
+
+            def replace(self, rows):
+                with self._lock:
+                    self.rows = tuple(rows)
+
+        locksets.install()
+        locksets.reset()
+        p = Pub([1, 2])
+        t = threading.Thread(target=lambda: p.replace([3]))
+        t.start(); t.join(5.0)
+        p.replace([4])
+        # two threads wrote, but all SAMPLED writes held the lock
+        assert locksets.violations() == []
+
+    def test_tracked_serve_boards_register(self):
+        """The live serve boards opted in: constructing them under the
+        recorder samples their counters (clean single-threaded use)."""
+        from ray_tpu.common import locksets
+        from ray_tpu.serve.gossip import LoadBoard
+        locksets.install()
+        locksets.reset()
+        board = LoadBoard()
+        board.fold("base", {0: {b"k": 1}}, {b"k"})
+        assert board.folds == 1
+        assert locksets.violations() == []
+
+
+# -- SARIF output -------------------------------------------------------------
+
+class TestSarif:
+    def _run(self, *extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.rtlint", "--format=sarif",
+             f"--root={REPO_ROOT}", *extra],
+            capture_output=True, text=True, timeout=120)
+        return proc, json.loads(proc.stdout)
+
+    def test_green_run_emits_suppressed_baseline(self):
+        proc, doc = self._run()
+        assert proc.returncode == 0
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "rtlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"W7", "W8"} <= rule_ids
+        results = run["results"]
+        assert results, "baselined findings must still be emitted"
+        for r in results:
+            assert r["suppressions"][0]["kind"] == "external"
+            assert r["level"] == "note"
+            assert r["partialFingerprints"]["rtlint/v1"]
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].startswith("ray_tpu/")
+
+    def test_no_baseline_run_emits_warnings(self):
+        proc, doc = self._run("--no-baseline", "--rules=W7")
+        assert proc.returncode == 1
+        results = doc["runs"][0]["results"]
+        assert results
+        for r in results:
+            assert r["level"] == "warning"
+            assert "suppressions" not in r
+
+
+# -- AST cache: single parse per file -----------------------------------------
+
+class TestAstCache:
+    def test_full_run_parses_each_file_once(self):
+        analyzer.clear_cache()
+        files = analyzer.iter_package_files(
+            os.path.join(REPO_ROOT, "ray_tpu"))
+        before = analyzer.parse_count()
+        analyzer.run_analysis(REPO_ROOT, "ray_tpu")     # all 8 rules
+        first = analyzer.parse_count() - before
+        assert first == len(files), \
+            f"{first} parses for {len(files)} files — cache broken"
+        # a second full run re-parses NOTHING (content unchanged)
+        analyzer.run_analysis(REPO_ROOT, "ray_tpu")
+        assert analyzer.parse_count() - before == first
+
+    def test_cache_invalidates_on_content_change(self, tmp_path):
+        mod = tmp_path / "fixturepkg" / "mod.py"
+        mod.parent.mkdir(parents=True)
+        (mod.parent / "common").mkdir()
+        (mod.parent / "common" / "config.py").write_text(CONFIG_STUB)
+        mod.write_text("x = 1\n")
+        analyzer.run_analysis(str(tmp_path), package="fixturepkg",
+                              rules=("W4",))
+        before = analyzer.parse_count()
+        analyzer.run_analysis(str(tmp_path), package="fixturepkg",
+                              rules=("W4",))
+        assert analyzer.parse_count() == before     # warm hit
+        mod.write_text("x = 2\n")
+        analyzer.run_analysis(str(tmp_path), package="fixturepkg",
+                              rules=("W4",))
+        assert analyzer.parse_count() == before + 1  # re-parsed once

@@ -13,6 +13,18 @@ invariants this codebase has already paid for in bugs:
     W4  thread-lifecycle           spawned threads are daemon or joined;
                                    pump loops don't silently swallow
                                    their own death
+    W5  virtual-clock-discipline   sim-reachable code takes time from
+                                   the clock seam, never ``time.*``
+    W6  device-transfer            no hidden host<->device syncs on the
+                                   scheduling hot path
+    W7  lockset-race               per-class Eraser: attributes shared
+                                   between thread-reachable contexts
+                                   must have a non-empty lockset
+                                   intersection
+    W8  replay-determinism         sim/trace-affecting code draws no
+                                   OS/global-stream entropy and feeds
+                                   no set-iteration order into the
+                                   trace hash or event schedule
 
 Run it:
 
@@ -23,11 +35,13 @@ Existing accepted sites live in ``tools/rtlint/baseline.json``
 (``--update-baseline`` regenerates it deterministically); anything NOT
 in the baseline fails the run, so the suite starts green and ratchets.
 
-The dynamic complement lives in ``ray_tpu/common/lockorder.py``: a
-config-gated (``rtlint_runtime_lock_order``) instrumented lock wrapper
-that records REAL acquisition order during the chaos/drain tests and
-asserts the observed graph stays acyclic — static analysis proposes,
-the chaos plane disposes.
+The dynamic complements live in ``ray_tpu/common/lockorder.py`` (W2:
+config-gated ``rtlint_runtime_lock_order`` lock wrapper that records
+REAL acquisition order) and ``ray_tpu/common/locksets.py`` (W7:
+config-gated ``rtlint_runtime_locksets`` Eraser recorder that samples
+per-thread held-sets at tracked attribute writes) — both armed during
+the chaos/drain tests: static analysis proposes, the chaos plane
+disposes.
 """
 
 from .finding import Finding

@@ -28,8 +28,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="repo root (default: rtlint's own checkout)")
     p.add_argument("--package", default="ray_tpu")
     p.add_argument("--rules", default=",".join(ALL_RULES),
-                   help="comma-separated subset of W1,W2,W3,W4,W5,W6")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+                   help="comma-separated subset of "
+                        "W1,W2,W3,W4,W5,W6,W7,W8")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--baseline", default=None,
                    help="baseline path (default: tools/rtlint/baseline.json "
                         "under --root)")
@@ -63,7 +65,10 @@ def main(argv=None) -> int:
         root, args.package, rules,
         baseline_path=None if args.no_baseline else bl_path)
 
-    if args.format == "json":
+    if args.format == "sarif":
+        from . import sarif
+        print(sarif.render(new, based, rules))
+    elif args.format == "json":
         print(json.dumps({
             "new": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in based],

@@ -1,29 +1,66 @@
-"""Analysis driver: file discovery, rule dispatch, baseline filtering."""
+"""Analysis driver: file discovery, rule dispatch, baseline filtering.
+
+Parsing is the dominant cost of a full-package run, so ``FileCtx``
+construction goes through a content-hash-keyed cache: every rule —
+and every repeated ``run_analysis``/``lock_graph``/``check`` call in
+one process (the test suite runs dozens) — reuses one parsed AST per
+distinct file content.  ``parse_count()`` exposes the real
+``ast.parse`` invocations so a test can assert the single-parse
+property.
+"""
 
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 
 from . import baseline as baseline_mod
-from . import (rules_device, rules_knobs, rules_locks, rules_threads,
-               rules_time)
+from . import (rules_determinism, rules_device, rules_knobs, rules_locks,
+               rules_races, rules_threads, rules_time)
 from .finding import Finding, sort_key
 
-ALL_RULES = ("W1", "W2", "W3", "W4", "W5", "W6")
+ALL_RULES = ("W1", "W2", "W3", "W4", "W5", "W6", "W7", "W8")
+
+_PARSE_COUNT = 0
+# (abspath, relpath) -> (content sha256, FileCtx)
+_CTX_CACHE: dict[tuple[str, str], tuple[str, "FileCtx"]] = {}
 
 
 class FileCtx:
     """One parsed source file handed to every rule."""
 
-    def __init__(self, abspath: str, relpath: str):
+    def __init__(self, abspath: str, relpath: str, src: str):
+        global _PARSE_COUNT
         self.abspath = abspath
         self.path = relpath.replace(os.sep, "/")
         self.module = os.path.splitext(os.path.basename(relpath))[0]
-        with open(abspath, "r", encoding="utf-8") as f:
-            src = f.read()
         self.lines = src.splitlines()
+        _PARSE_COUNT += 1
         self.tree = ast.parse(src, filename=abspath)
+
+
+def get_ctx(abspath: str, relpath: str) -> FileCtx:
+    """Cached FileCtx: re-parse only when the file content changed."""
+    with open(abspath, "r", encoding="utf-8") as f:
+        src = f.read()
+    sha = hashlib.sha256(src.encode("utf-8")).hexdigest()
+    key = (abspath, relpath)
+    hit = _CTX_CACHE.get(key)
+    if hit is not None and hit[0] == sha:
+        return hit[1]
+    ctx = FileCtx(abspath, relpath, src)
+    _CTX_CACHE[key] = (sha, ctx)
+    return ctx
+
+
+def parse_count() -> int:
+    """Total ``ast.parse`` calls this process (single-parse assert)."""
+    return _PARSE_COUNT
+
+
+def clear_cache() -> None:
+    _CTX_CACHE.clear()
 
 
 def iter_package_files(pkg_dir: str) -> list[str]:
@@ -52,13 +89,14 @@ def run_analysis(repo_root: str, package: str = "ray_tpu",
     for path in files:
         rel = os.path.relpath(path, repo_root)
         try:
-            ctxs.append(FileCtx(path, rel))
+            ctxs.append(get_ctx(path, rel))
         except SyntaxError as e:
             findings.append(Finding(
                 rule="E0", path=rel.replace(os.sep, "/"),
                 line=e.lineno or 0, symbol="<parse>",
                 message=f"syntax error: {e.msg}", detail="syntax-error"))
 
+    need_lockpass = bool({"W1", "W2", "W7"} & set(rules))
     lock_passes = []
     knob_refs: set[str] = set()
     knob_strings: set[str] = set()
@@ -67,11 +105,13 @@ def run_analysis(repo_root: str, package: str = "ray_tpu",
         ("W3" in rules and os.path.exists(config_abs)) else {}
 
     for ctx in ctxs:
-        if "W1" in rules or "W2" in rules:
+        if need_lockpass:
             w1, fpass = rules_locks.scan_file(ctx)
             lock_passes.append(fpass)
             if "W1" in rules:
                 findings.extend(w1)
+            if "W7" in rules:
+                findings.extend(rules_races.scan_file(ctx, fpass))
         if defs:
             kf, refs, strings = rules_knobs.scan_file(ctx, defs)
             # config.py itself mentions every knob as a dict key: its
@@ -86,6 +126,8 @@ def run_analysis(repo_root: str, package: str = "ray_tpu",
             findings.extend(rules_time.scan_file(ctx))
         if "W6" in rules:
             findings.extend(rules_device.scan_file(ctx))
+        if "W8" in rules:
+            findings.extend(rules_determinism.scan_file(ctx))
 
     if "W1" in rules and lock_passes:
         findings.extend(rules_locks.interprocedural_w1(lock_passes))
@@ -106,7 +148,7 @@ def lock_graph(repo_root: str, package: str = "ray_tpu") -> dict:
     pkg_dir = os.path.join(repo_root, package)
     passes = []
     for path in iter_package_files(pkg_dir):
-        ctx = FileCtx(path, os.path.relpath(path, repo_root))
+        ctx = get_ctx(path, os.path.relpath(path, repo_root))
         _, p = rules_locks.scan_file(ctx)
         passes.append(p)
     return rules_locks.build_graph(passes)
